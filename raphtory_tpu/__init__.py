@@ -1,0 +1,14 @@
+"""raphtory_tpu — a TPU-native temporal graph analytics framework.
+
+Brand-new design with the capabilities of Raphtory (Scala/Akka era):
+streaming ingestion into an append-only bitemporal store, and Pregel-style
+BSP analysis over historical views/windows — re-expressed as JAX/XLA SPMD
+programs over immutable CSR snapshots sharded across a TPU mesh.
+"""
+
+from .core.events import EventLog
+from .core.snapshot import GraphView, build_view
+
+__version__ = "0.1.0"
+
+__all__ = ["EventLog", "GraphView", "build_view", "__version__"]

@@ -1,0 +1,16 @@
+"""Test harness config: force a virtual 8-device CPU mesh BEFORE jax import.
+
+Multi-node-without-a-cluster is a first-class capability (the reference's
+single-node docker collapse, README.md:51-58); here it's a CPU-simulated
+device mesh, per SURVEY.md §4.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

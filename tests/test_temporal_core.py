@@ -1,0 +1,283 @@
+"""Temporal-core semantics: bitemporal histories, tombstones, windows,
+properties — and the permutation-invariance (commutativity) invariant the
+reference states (`README.md:6`: updates can arrive out of order)."""
+
+import numpy as np
+import pytest
+
+from raphtory_tpu.core.events import (
+    EDGE_ADD,
+    EDGE_DELETE,
+    VERTEX_ADD,
+    VERTEX_DELETE,
+    EventLog,
+)
+from raphtory_tpu.core.snapshot import build_view
+
+
+def _edges(view):
+    """Set of (global_src, global_dst) alive edges."""
+    s = view.vids[view.e_src[view.e_mask]]
+    d = view.vids[view.e_dst[view.e_mask]]
+    return set(zip(s.tolist(), d.tolist()))
+
+
+def _verts(view):
+    return set(view.vids[view.v_mask].tolist())
+
+
+def test_vertex_add_and_delete():
+    log = EventLog()
+    log.add_vertex(1, 10)
+    log.add_vertex(2, 20)
+    log.delete_vertex(5, 10)
+    assert _verts(build_view(log, 1)) == {10}
+    assert _verts(build_view(log, 2)) == {10, 20}
+    assert _verts(build_view(log, 4)) == {10, 20}
+    assert _verts(build_view(log, 5)) == {20}
+    # revival after tombstone
+    log.add_vertex(7, 10)
+    assert _verts(build_view(log, 6)) == {20}
+    assert _verts(build_view(log, 7)) == {10, 20}
+
+
+def test_view_before_first_event_is_empty():
+    log = EventLog()
+    log.add_vertex(10, 1)
+    v = build_view(log, 5)
+    assert v.n_active == 0 and v.m_active == 0
+
+
+def test_edge_add_implies_endpoint_vertices():
+    # EntityStorage.edgeAdd calls vertexAdd for src and dst
+    log = EventLog()
+    log.add_edge(3, 1, 2)
+    v = build_view(log, 3)
+    assert _verts(v) == {1, 2}
+    assert _edges(v) == {(1, 2)}
+
+
+def test_edge_delete_keeps_vertices():
+    log = EventLog()
+    log.add_edge(1, 1, 2)
+    log.delete_edge(4, 1, 2)
+    v = build_view(log, 5)
+    assert _edges(v) == set()
+    assert _verts(v) == {1, 2}
+
+
+def test_vertex_delete_kills_incident_edges():
+    # killList propagation: Edge.scala:36-44, EntityStorage.scala:148-232
+    log = EventLog()
+    log.add_edge(1, 1, 2)
+    log.add_edge(2, 3, 1)
+    log.add_edge(2, 2, 3)
+    log.delete_vertex(5, 1)
+    v = build_view(log, 6)
+    assert _verts(v) == {2, 3}
+    assert _edges(v) == {(2, 3)}
+    # re-adding the edge revives vertex and edge
+    log.add_edge(8, 1, 2)
+    v = build_view(log, 8)
+    assert _verts(v) == {1, 2, 3}
+    assert _edges(v) == {(1, 2), (2, 3)}
+
+
+def test_vertex_delete_before_edge_add_does_not_kill_later_edge():
+    log = EventLog()
+    log.delete_vertex(2, 1)
+    log.add_edge(5, 1, 2)
+    v = build_view(log, 6)
+    assert _edges(v) == {(1, 2)}
+    assert _verts(v) == {1, 2}
+
+
+def test_same_timestamp_delete_wins():
+    # deterministic tie-break: tombstone preference
+    log = EventLog()
+    log.add_vertex(3, 1)
+    log.delete_vertex(3, 1)
+    assert _verts(build_view(log, 3)) == set()
+    log2 = EventLog()
+    log2.delete_vertex(3, 1)  # reversed arrival order
+    log2.add_vertex(3, 1)
+    assert _verts(build_view(log2, 3)) == set()
+
+
+def test_window_semantics():
+    # aliveAtWithWindow: latest point <= T must be alive AND >= T - W
+    log = EventLog()
+    log.add_vertex(10, 1)
+    log.add_vertex(100, 2)
+    log.add_edge(50, 3, 4)
+    v = build_view(log, 100)
+    vm, em = v.window_masks([1000, 60, 10])
+    ids = v.vids
+    def vset(mask):
+        return set(ids[mask].tolist())
+    assert vset(vm[0]) == {1, 2, 3, 4}
+    assert vset(vm[1]) == {2, 3, 4}       # vertex 1 last active at 10 < 40
+    assert vset(vm[2]) == {2}             # only events >= 90
+    # batched windows are monotone refinements (shrinkWindow semantics)
+    assert np.all(vm[1] <= vm[0]) and np.all(vm[2] <= vm[1])
+    assert np.all(em[1] <= em[0]) and np.all(em[2] <= em[1])
+
+
+def test_window_uses_latest_point_only():
+    # vertex active at 10 then again at 95: in window 10 @T=100
+    log = EventLog()
+    log.add_vertex(10, 1)
+    log.add_vertex(95, 1)
+    v = build_view(log, 100)
+    vm, _ = v.window_masks([10])
+    assert set(v.vids[vm[0]].tolist()) == {1}
+
+
+def test_out_of_order_ingestion_commutativity():
+    """The core invariant: any permutation of the same update multiset yields
+    an identical graph at every query time."""
+    rng = np.random.default_rng(0)
+    n_events = 400
+    ids = rng.integers(0, 30, size=(n_events, 2))
+    times = rng.integers(0, 200, size=n_events)
+    kinds = rng.choice(
+        [VERTEX_ADD, VERTEX_DELETE, EDGE_ADD, EDGE_DELETE],
+        p=[0.25, 0.1, 0.45, 0.2],
+        size=n_events,
+    )
+    events = list(zip(times.tolist(), kinds.tolist(), ids[:, 0].tolist(), ids[:, 1].tolist()))
+
+    def apply(evts):
+        log = EventLog()
+        for t, k, a, b in evts:
+            if k == VERTEX_ADD:
+                log.add_vertex(t, a)
+            elif k == VERTEX_DELETE:
+                log.delete_vertex(t, a)
+            elif k == EDGE_ADD:
+                log.add_edge(t, a, b)
+            else:
+                log.delete_edge(t, a, b)
+        return log
+
+    log_a = apply(events)
+    for perm_seed in range(3):
+        perm = np.random.default_rng(perm_seed + 1).permutation(n_events)
+        log_b = apply([events[i] for i in perm])
+        for T in [0, 50, 100, 199, 500]:
+            va, vb = build_view(log_a, T), build_view(log_b, T)
+            assert _verts(va) == _verts(vb), f"T={T} perm={perm_seed}"
+            assert _edges(va) == _edges(vb), f"T={T} perm={perm_seed}"
+            # latest-times must agree too (window masks depend on them)
+            assert np.array_equal(
+                va.v_latest_time[va.v_mask], vb.v_latest_time[vb.v_mask]
+            )
+            assert np.array_equal(
+                np.sort(va.e_latest_time[va.e_mask]),
+                np.sort(vb.e_latest_time[vb.e_mask]),
+            )
+
+
+def test_degrees_and_csr():
+    log = EventLog()
+    log.add_edge(1, 1, 2)
+    log.add_edge(2, 1, 3)
+    log.add_edge(3, 2, 3)
+    v = build_view(log, 10)
+    li = v.local_index([1, 2, 3])
+    assert v.out_deg[li[0]] == 2
+    assert v.out_deg[li[1]] == 1
+    assert v.in_deg[li[2]] == 2
+    assert v.in_indptr[-1] == v.m_active or v.in_indptr[-1] <= v.m_pad
+    # out CSR: edges of vertex 1 under out_order
+    o = v.out_order[v.out_indptr[li[0]] : v.out_indptr[li[0] + 1]]
+    dsts = set(v.vids[v.e_dst[o]].tolist())
+    assert dsts == {2, 3}
+
+
+def test_parallel_edge_dedup_latest_time():
+    # repeated edge adds merge into one alive edge with latest activity time
+    log = EventLog()
+    log.add_edge(1, 1, 2)
+    log.add_edge(7, 1, 2)
+    log.add_edge(4, 1, 2)
+    v = build_view(log, 10)
+    assert v.m_active == 1
+    assert v.e_latest_time[0] == 7
+    assert v.e_first_time[0] == 1
+
+
+def test_mutable_property_latest_value():
+    log = EventLog()
+    log.add_vertex(1, 1, {"score": 1.5})
+    log.add_vertex(5, 1, {"score": 2.5})
+    log.add_vertex(3, 2, {"score": 9.0})
+    v4 = build_view(log, 4)
+    p = v4.vertex_prop("score")
+    li = v4.local_index([1, 2])
+    assert p[li[0]] == 1.5
+    assert p[li[1]] == 9.0
+    v6 = build_view(log, 6)
+    assert v6.vertex_prop("score")[v6.local_index([1])[0]] == 2.5
+
+
+def test_immutable_property_first_value_wins():
+    # ImmutableProperty: earliest value is the value
+    log = EventLog()
+    log.add_vertex(5, 1, {"!kind": 7.0})
+    log.add_vertex(9, 1, {"!kind": 8.0})
+    log.add_vertex(2, 1, {"!kind": 6.0})  # arrives late but is earliest
+    v = build_view(log, 10)
+    assert v.vertex_prop("kind")[v.local_index([1])[0]] == 6.0
+
+
+def test_edge_property():
+    log = EventLog()
+    log.add_edge(1, 1, 2, {"w": 0.5})
+    log.add_edge(6, 1, 2, {"w": 0.9})
+    log.add_edge(2, 2, 3, {"w": 0.1})
+    v = build_view(log, 10)
+    w = v.edge_prop("w")
+    for i in range(v.m_active):
+        s, d = v.vids[v.e_src[i]], v.vids[v.e_dst[i]]
+        if (s, d) == (1, 2):
+            assert w[i] == 0.9
+        else:
+            assert w[i] == 0.1
+
+
+def test_occurrences_multigraph():
+    log = EventLog()
+    log.add_edge(1, 1, 2)
+    log.add_edge(5, 1, 2)
+    log.add_edge(3, 2, 3)
+    log.delete_edge(9, 2, 3)
+    v = build_view(log, 10, include_occurrences=True)
+    occ = [
+        (v.vids[v.occ_src[i]], v.vids[v.occ_dst[i]], v.occ_time[i])
+        for i in range(len(v.occ_src))
+        if v.occ_mask[i]
+    ]
+    # only occurrences of ALIVE edges: (1,2)@1 and @5; (2,3) deleted
+    assert sorted(occ) == [(1, 2, 1), (1, 2, 5)]
+
+
+def test_batch_append():
+    log = EventLog()
+    t = np.array([1, 2, 3], np.int64)
+    k = np.array([EDGE_ADD, EDGE_ADD, VERTEX_DELETE], np.uint8)
+    s = np.array([1, 2, 1], np.int64)
+    d = np.array([2, 3, -1], np.int64)
+    log.append_batch(t, k, s, d)
+    v = build_view(log, 10)
+    assert _verts(v) == {2, 3}
+    assert _edges(v) == {(2, 3)}
+
+
+def test_growth_beyond_initial_capacity():
+    log = EventLog()
+    for i in range(3000):
+        log.add_edge(i, i % 50, (i + 1) % 50)
+    v = build_view(log, 3000)
+    assert v.n_active == 50
+    assert log.n == 3000
