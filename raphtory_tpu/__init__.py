@@ -6,9 +6,30 @@ BSP analysis over historical views/windows — re-expressed as JAX/XLA SPMD
 programs over immutable CSR snapshots sharded across a TPU mesh.
 """
 
+import os as _os
+
+# Vertex ids and event times are int64; enable x64 before any jax use.
+# Engine/device code keeps compute dtypes explicit (f32/bf16/i32) so the MXU
+# path is unaffected. Opt out with RAPHTORY_TPU_X64=0.
+if _os.environ.get("RAPHTORY_TPU_X64", "1") != "0":
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
+
 from .core.events import EventLog
 from .core.snapshot import GraphView, build_view
+from .engine import bsp
+from .engine.program import Context, Edges, VertexProgram
 
 __version__ = "0.1.0"
 
-__all__ = ["EventLog", "GraphView", "build_view", "__version__"]
+__all__ = [
+    "EventLog",
+    "GraphView",
+    "build_view",
+    "bsp",
+    "VertexProgram",
+    "Context",
+    "Edges",
+    "__version__",
+]

@@ -1,0 +1,8 @@
+"""Built-in algorithm library — parity with the reference's
+``core/analysis/Algorithms/`` plus the example-space analysers (SURVEY §2.8)."""
+
+from .connected_components import ConnectedComponents
+from .degree import DegreeBasic
+from .pagerank import PageRank
+
+__all__ = ["ConnectedComponents", "DegreeBasic", "PageRank"]

@@ -352,8 +352,12 @@ def build_view(
     ae_first = ae_first[eorder]
 
     m_pad = _pad_bucket(m_active) if pad == "pow2" else _round_up(m_active, 8)
-    e_src = np.zeros(m_pad, np.int32)
-    e_dst = np.zeros(m_pad, np.int32)
+    # Padding rows use dst index n_pad-1 (the max) so the dst-sorted order
+    # survives padding — segment ops are called with indices_are_sorted=True
+    # and XLA's sorted-scatter lowering on TPU relies on the promise. Padded
+    # rows carry combiner-neutral payloads, so where they land is harmless.
+    e_src = np.full(m_pad, n_pad - 1, np.int32)
+    e_dst = np.full(m_pad, n_pad - 1, np.int32)
     e_mask = np.zeros(m_pad, bool)
     e_lat = np.full(m_pad, INT64_MIN, np.int64)
     e_fst = np.full(m_pad, INT64_MIN, np.int64)
@@ -447,8 +451,8 @@ def _attach_occurrences(view: GraphView, ea_rows, ea_t, ea_s, ea_d) -> None:
     idx = np.flatnonzero(ok)
     o = len(idx)
     o_pad = _pad_bucket(o)
-    occ_src = np.zeros(o_pad, np.int32)
-    occ_dst = np.zeros(o_pad, np.int32)
+    occ_src = np.full(o_pad, view.n_pad - 1, np.int32)
+    occ_dst = np.full(o_pad, view.n_pad - 1, np.int32)
     occ_time = np.full(o_pad, INT64_MIN, np.int64)
     occ_mask = np.zeros(o_pad, bool)
     order = np.lexsort((sl[idx], dl[idx]))

@@ -1,0 +1,184 @@
+"""The BSP superstep engine — one jit-compiled SPMD program per algorithm.
+
+Replaces the reference's actor-driven superstep machinery: the
+``AnalysisTask`` coordinator counting ``Ready``/``EndStep`` acks and probing
+message quiescence (``AnalysisTask.scala:197-283``), ``ReaderWorker``
+executing ``analyse()`` per shard (``ReaderWorker.scala:159-219``), and the
+``VertexMutliQueue`` double-buffered mailboxes. In the compiled model the
+barrier is implicit (it's one XLA program), quiescence/vote counting is a
+reduction, and the message exchange is a gather + segment-combine.
+
+Batched windows (``ReaderWorker.scala:180-187`` running the algorithm once
+per window against a shrinking lens) become a leading window axis driven by
+``jax.vmap`` — every window advances in the same compiled superstep, and
+halted windows freeze via ``jnp.where``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.snapshot import GraphView
+from ..ops.segment import combine_tree, segment_combine
+from .program import Context, Edges, VertexProgram
+
+_elem = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
+
+
+def _merge_aggs(op: str, a, b):
+    return jax.tree_util.tree_map(_elem[op], a, b)
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_runner(program: VertexProgram, n: int, m: int, k: int,
+                     prop_keys: tuple, vprop_keys: tuple):
+    """One compiled program per (algorithm instance, padded shapes, #windows).
+
+    Range sweeps at the same bucketed shape hit this cache — the amortisation
+    the reference never had (fresh handshake per hop,
+    ``RangeAnalysisTask.scala:18-35``).
+    """
+
+    def one_superstep(state, v_mask, e_mask, out_deg, in_deg, ctx, edges):
+        agg = None
+        if program.direction in ("out", "both"):
+            src_state = jax.tree_util.tree_map(lambda a: a[edges.src], state)
+            payload = program.message(src_state, edges)
+            agg = combine_tree(payload, edges.dst, n, program.combiner,
+                               e_mask, indices_are_sorted=True)
+        if program.direction in ("in", "both"):
+            src_state = jax.tree_util.tree_map(lambda a: a[edges.dst], state)
+            payload = program.message(src_state, edges)
+            agg_in = combine_tree(payload, edges.src, n, program.combiner,
+                                  e_mask, indices_are_sorted=False)
+            agg = agg_in if agg is None else _merge_aggs(program.combiner, agg, agg_in)
+        new_state, votes = program.update(state, agg, ctx)
+        halted = jnp.all(votes | ~v_mask)
+        return new_state, halted
+
+    def run(v_masks, e_masks, vids, v_latest, v_first,
+            e_src, e_dst, e_latest, e_first,
+            time, windows, eprops, vprops):
+        # per-window degrees: one segment-sum over the masked edge set
+        ones = jnp.ones((m,), jnp.int32)
+
+        def degs(em):
+            ind = segment_combine(ones, e_dst, n, "sum", em, True)
+            out = segment_combine(ones, e_src, n, "sum", em, False)
+            return out, ind
+
+        out_deg, in_deg = jax.vmap(degs)(e_masks)
+
+        def mk_ctx(kk, step):
+            return Context(
+                n=n, time=time, window=windows[kk], v_mask=v_masks[kk],
+                vids=vids, v_latest_time=v_latest, v_first_time=v_first,
+                out_deg=out_deg[kk], in_deg=in_deg[kk],
+                n_active=jnp.sum(v_masks[kk].astype(jnp.int32)),
+                step=step, vprops=vprops,
+            )
+
+        def init_k(kk):
+            return program.init(mk_ctx(kk, jnp.int32(0)))
+
+        state0 = jax.vmap(init_k)(jnp.arange(k))
+
+        def step_k(kk, st, step):
+            ctx = mk_ctx(kk, step)
+            ek = Edges(src=e_src, dst=e_dst, mask=e_masks[kk], time=e_latest,
+                       first_time=e_first, props=eprops)
+            return one_superstep(st, v_masks[kk], e_masks[kk],
+                                 out_deg[kk], in_deg[kk], ctx, ek)
+
+        vstep = jax.vmap(step_k, in_axes=(0, 0, None))
+
+        if program.max_steps > 0:
+            def cond(carry):
+                step, _, halted = carry
+                return (step < program.max_steps) & ~jnp.all(halted)
+
+            def body(carry):
+                step, st, halted = carry
+                new_st, new_halt = vstep(jnp.arange(k), st, step)
+                # freeze halted windows
+                st = jax.tree_util.tree_map(
+                    lambda old, new: jnp.where(
+                        halted.reshape((k,) + (1,) * (new.ndim - 1)), old, new),
+                    st, new_st)
+                return step + 1, st, halted | new_halt
+
+            steps, state, halted = jax.lax.while_loop(
+                cond, body, (jnp.int32(0), state0, jnp.zeros((k,), bool)))
+        else:
+            steps, state = jnp.int32(0), state0
+
+        def fin_k(kk, st):
+            return program.finalize(st, mk_ctx(kk, steps))
+
+        result = jax.vmap(fin_k, in_axes=(0, 0))(jnp.arange(k), state)
+        return result, steps
+
+    return jax.jit(run)
+
+
+def _gather_props(view: GraphView, keys, kind: str):
+    out = {}
+    for name in keys:
+        arr = view.edge_prop(name) if kind == "e" else view.vertex_prop(name)
+        out[name] = jnp.asarray(arr, jnp.float32)
+    return out
+
+
+def run(
+    program: VertexProgram,
+    view: GraphView,
+    *,
+    window: int | None = None,
+    windows=None,
+):
+    """Execute a vertex program against a view.
+
+    window=None, windows=None → plain view ({View,Range}AnalysisTask).
+    window=w                  → single window (Windowed*).
+    windows=[w0 > w1 > ...]   → batched windows, one result per window
+                                (BWindowed*; leading axis on the result).
+    """
+    batched = windows is not None
+    if windows is None:
+        windows = [window if window is not None else -1]
+    wlist = list(windows)
+    k = len(wlist)
+
+    v_masks = np.empty((k, view.n_pad), bool)
+    e_masks = np.empty((k, view.m_pad), bool)
+    for i, w in enumerate(wlist):
+        if w is None or w < 0:
+            v_masks[i] = view.v_mask
+            e_masks[i] = view.e_mask
+        else:
+            vm, em = view.window_masks([w])
+            v_masks[i], e_masks[i] = vm[0], em[0]
+
+    runner = _compiled_runner(
+        program, view.n_pad, view.m_pad, k,
+        tuple(program.edge_props), tuple(program.vertex_props),
+    )
+    eprops = _gather_props(view, program.edge_props, "e")
+    vprops = _gather_props(view, program.vertex_props, "v")
+    win_arr = jnp.asarray([(-1 if w is None else int(w)) for w in wlist], jnp.int64)
+
+    result, steps = runner(
+        jnp.asarray(v_masks), jnp.asarray(e_masks),
+        jnp.asarray(view.vids), jnp.asarray(view.v_latest_time),
+        jnp.asarray(view.v_first_time),
+        jnp.asarray(view.e_src), jnp.asarray(view.e_dst),
+        jnp.asarray(view.e_latest_time), jnp.asarray(view.e_first_time),
+        jnp.asarray(view.time, jnp.int64), win_arr, eprops, vprops,
+    )
+    if not batched:
+        result = jax.tree_util.tree_map(lambda a: a[0], result)
+    return result, int(steps)

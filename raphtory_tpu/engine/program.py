@@ -1,0 +1,112 @@
+"""The vertex-program contract — TPU-native ``Analyser`` equivalent.
+
+The reference's user algorithm contract is the ``Analyser`` trait
+(``core/analysis/API/Analyser.scala:30-63``): ``setup()``, ``analyse()`` (one
+superstep of per-vertex code sending point-to-point messages), result
+reducers, ``defineMaxSteps()``. Here an algorithm is a frozen dataclass of
+pure array functions over the WHOLE vertex/edge set at once:
+
+    init(ctx)                  -> state pytree          (Analyser.setup)
+    message(src_state, edge)   -> payload pytree        (messageNeighbour)
+    update(state, agg, ctx)    -> (state, halt_votes)   (Analyser.analyse + voteToHalt)
+    finalize(state, ctx)       -> result pytree         (returnResults)
+
+Being a frozen dataclass makes the program hashable, so the engine passes it
+to jit as a static argument: one compiled superstep program per
+(algorithm, hyperparams, padded shapes) — reused across every hop of a range
+sweep (the reference re-runs the whole actor handshake per hop,
+``RangeAnalysisTask.scala:18-35``).
+
+Messages always flow along edges; ``direction`` picks out-edges ('out':
+src→dst), in-edges ('in': dst→src), or 'both'. Aggregation at the receiver is
+an associative-commutative ``combiner`` ('sum' | 'min' | 'max') — the
+narrowing of the reference's arbitrary typed messages that makes vertex
+messaging a segment reduction (SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class Edges:
+    """Per-edge arrays visible to ``message`` (masked rows are neutralised by
+    the engine). ``time``/``first_time`` are the latest/earliest history
+    points — the temporal columns that power time-aware algorithms."""
+
+    src: jnp.ndarray          # i32[m] local source index
+    dst: jnp.ndarray          # i32[m] local destination index
+    mask: jnp.ndarray         # bool[m] (already window-restricted)
+    time: jnp.ndarray         # i64[m] latest activity <= T
+    first_time: jnp.ndarray   # i64[m]
+    props: dict[str, jnp.ndarray]   # f32[m] per requested key
+
+
+@dataclass(frozen=True)
+class Context:
+    """Per-superstep global context visible to ``init``/``update``/``finalize``.
+
+    The analogue of the reference's injected ``sysSetup(context, managerCount,
+    proxy: GraphLens, workerID)`` (``Analyser.scala:37-42``) — but the "lens"
+    is just arrays.
+    """
+
+    n: int                    # padded vertex count (static)
+    time: jnp.ndarray         # i64 scalar: view timestamp
+    window: jnp.ndarray       # i64 scalar: window size (-1 = none)
+    v_mask: jnp.ndarray       # bool[n] in-view/in-window vertices
+    vids: jnp.ndarray         # i64[n] global ids (-1 pad)
+    v_latest_time: jnp.ndarray
+    v_first_time: jnp.ndarray
+    out_deg: jnp.ndarray      # i32[n] under current mask
+    in_deg: jnp.ndarray       # i32[n]
+    n_active: jnp.ndarray     # i32 scalar: |v_mask|
+    step: jnp.ndarray         # i32 scalar: current superstep
+    vprops: dict[str, jnp.ndarray]
+
+    @property
+    def num_vertices(self) -> jnp.ndarray:
+        """Active vertex count as f32 (handy for PageRank-style normalisers)."""
+        return self.n_active.astype(jnp.float32)
+
+
+class VertexProgram:
+    """Base class; subclass as @dataclass(frozen=True) with hyperparams as
+    fields. Class attributes configure the engine."""
+
+    combiner: str = "sum"
+    direction: str = "out"          # 'out' | 'in' | 'both'
+    max_steps: int = 20
+    edge_props: tuple[str, ...] = ()
+    vertex_props: tuple[str, ...] = ()
+    needs_occurrences: bool = False  # multigraph temporal algorithms
+
+    # -- pure array functions --
+
+    def init(self, ctx: Context) -> Any:
+        raise NotImplementedError
+
+    def message(self, src_state: Any, edge: Edges) -> Any:
+        """Payload sent along each edge, computed from the SENDER's state.
+        For direction='in' the "sender" is the edge's dst vertex; for 'both'
+        it's called once per direction."""
+        raise NotImplementedError
+
+    def update(self, state: Any, agg: Any, ctx: Context):
+        """Fold the combined inbox into new state; return (state, halt_votes)
+        with halt_votes bool[n] True where the vertex votes to halt."""
+        raise NotImplementedError
+
+    def finalize(self, state: Any, ctx: Context) -> Any:
+        return state
+
+    # -- host-side reduction (Analyser.processResults analogue) --
+
+    def reduce(self, result, view, window=None):
+        """Turn device results into the job-level answer (host code).
+        Default: pass through."""
+        return result

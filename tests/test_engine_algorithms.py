@@ -1,0 +1,179 @@
+"""BSP engine + algorithm golden tests vs pure-numpy reference
+implementations (the test pyramid the reference lacks, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from raphtory_tpu import EventLog, build_view
+from raphtory_tpu.algorithms import ConnectedComponents, DegreeBasic, PageRank
+from raphtory_tpu.engine import bsp
+
+
+def _random_log(seed, n_ids=40, n_events=300, t_max=100):
+    rng = np.random.default_rng(seed)
+    log = EventLog()
+    for _ in range(n_events):
+        t = int(rng.integers(0, t_max))
+        a, b = (int(x) for x in rng.integers(0, n_ids, 2))
+        r = rng.random()
+        if r < 0.5:
+            log.add_edge(t, a, b)
+        elif r < 0.65:
+            log.add_vertex(t, a)
+        elif r < 0.8:
+            log.delete_edge(t, a, b)
+        else:
+            log.delete_vertex(t, a)
+    return log
+
+
+def _np_components(view, e_mask=None, v_mask=None):
+    """Union-find reference."""
+    vm = view.v_mask if v_mask is None else v_mask
+    em = view.e_mask if e_mask is None else e_mask
+    parent = np.arange(view.n_pad)
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for i in np.flatnonzero(em):
+        a, b = find(view.e_src[i]), find(view.e_dst[i])
+        if a != b:
+            parent[max(a, b)] = min(a, b)
+    labels = np.array([find(i) for i in range(view.n_pad)])
+    return {frozenset(np.flatnonzero((labels == l) & vm).tolist())
+            for l in np.unique(labels[vm])}
+
+
+def _np_pagerank(view, damping=0.85, iters=60):
+    vm = view.v_mask
+    n = vm.sum()
+    pr = np.where(vm, 1.0 / max(n, 1), 0.0)
+    outd = view.out_deg.astype(float)
+    em = view.e_mask
+    for _ in range(iters):
+        contrib = np.zeros(view.n_pad)
+        s, d = view.e_src[em], view.e_dst[em]
+        np.add.at(contrib, d, pr[s] / np.maximum(outd[s], 1.0))
+        dangling = pr[vm & (view.out_deg == 0)].sum()
+        pr = np.where(vm, (1 - damping) / n + damping * (contrib + dangling / n), 0.0)
+    return pr
+
+
+def test_cc_on_known_graph():
+    log = EventLog()
+    # two components: {1,2,3} triangle-ish and {10,11}
+    log.add_edge(1, 1, 2)
+    log.add_edge(2, 2, 3)
+    log.add_edge(3, 10, 11)
+    view = build_view(log, 10)
+    labels, steps = bsp.run(ConnectedComponents(), view)
+    labels = np.asarray(labels)
+    li = view.local_index([1, 2, 3, 10, 11])
+    assert labels[li[0]] == labels[li[1]] == labels[li[2]]
+    assert labels[li[3]] == labels[li[4]]
+    assert labels[li[0]] != labels[li[3]]
+    stats = ConnectedComponents().reduce(labels, view)
+    assert stats["clusters"] == 2
+    assert stats["biggest"] == 3
+    assert stats["islands"] == 0
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_cc_random_vs_union_find(seed):
+    log = _random_log(seed)
+    view = build_view(log, 80)
+    labels, _ = bsp.run(ConnectedComponents(), view)
+    labels = np.asarray(labels)
+    got = {
+        frozenset(np.flatnonzero((labels == l) & view.v_mask).tolist())
+        for l in np.unique(labels[view.v_mask])
+    }
+    assert got == _np_components(view)
+
+
+def test_cc_windowed_batch_matches_per_window():
+    log = _random_log(7)
+    view = build_view(log, 90)
+    windows = [100, 30, 5]
+    batched, _ = bsp.run(ConnectedComponents(), view, windows=windows)
+    batched = np.asarray(batched)
+    for i, w in enumerate(windows):
+        single, _ = bsp.run(ConnectedComponents(), view, window=w)
+        single = np.asarray(single)
+        vm, em = view.window_masks([w])
+        # same partition into components
+        got_b = {
+            frozenset(np.flatnonzero((batched[i] == l) & vm[0]).tolist())
+            for l in np.unique(batched[i][vm[0]])
+        }
+        got_s = {
+            frozenset(np.flatnonzero((single == l) & vm[0]).tolist())
+            for l in np.unique(single[vm[0]])
+        }
+        ref = _np_components(view, e_mask=em[0], v_mask=vm[0])
+        assert got_b == ref == got_s, f"window {w}"
+
+
+def test_pagerank_sums_to_one_and_matches_numpy():
+    log = _random_log(3)
+    view = build_view(log, 95)
+    pr = PageRank(max_steps=60, tol=0.0)
+    ranks, steps = bsp.run(pr, view)
+    ranks = np.asarray(ranks)
+    assert ranks[~view.v_mask].sum() == 0
+    np.testing.assert_allclose(ranks.sum(), 1.0, atol=1e-3)
+    ref = _np_pagerank(view, iters=60)
+    np.testing.assert_allclose(ranks, ref, atol=1e-4)
+
+
+def test_pagerank_early_halt_on_convergence():
+    log = EventLog()
+    log.add_edge(1, 1, 2)
+    log.add_edge(1, 2, 1)
+    view = build_view(log, 2)
+    ranks, steps = bsp.run(PageRank(max_steps=50, tol=1e-9), view)
+    assert steps < 50  # symmetric 2-cycle converges immediately
+
+
+def test_degree_program():
+    log = EventLog()
+    log.add_edge(1, 1, 2)
+    log.add_edge(2, 1, 3)
+    log.add_edge(3, 2, 3)
+    view = build_view(log, 5)
+    res, steps = bsp.run(DegreeBasic(), view)
+    assert steps == 0
+    stats = DegreeBasic().reduce(res, view)
+    assert stats["vertices"] == 3
+    assert stats["total_in"] == 3 and stats["total_out"] == 3
+    assert stats["max_out"] == 2
+    outd = np.asarray(res["out"])
+    assert outd[view.local_index([1])[0]] == 2
+
+
+def test_compiled_runner_cache_reuse_across_range_hops():
+    """Range sweeps at the same padded shape must not retrace."""
+    from raphtory_tpu.engine.bsp import _compiled_runner
+
+    _compiled_runner.cache_clear()
+    log = _random_log(5, n_ids=30, n_events=250)
+    prog = ConnectedComponents()
+    for T in [40, 60, 80, 99]:
+        view = build_view(log, T)
+        bsp.run(prog, view)
+    info = _compiled_runner.cache_info()
+    assert info.misses <= 2  # at most a couple of shape buckets
+    assert info.hits >= 2
+
+
+def test_empty_view_runs():
+    log = EventLog()
+    log.add_vertex(100, 1)
+    view = build_view(log, 5)  # before any event
+    labels, _ = bsp.run(ConnectedComponents(), view)
+    stats = ConnectedComponents().reduce(np.asarray(labels), view)
+    assert stats["vertices"] == 0 and stats["clusters"] == 0
