@@ -7,9 +7,9 @@ neighbourhood (both directions), votes to halt when unchanged; the reducer
 reports cluster count / biggest / islands / average like the reference's
 ``processResults`` (``ConnectedComponents.scala:44-122``).
 
-TPU note: labels are LOCAL vertex indices (i32) on device — the MXU/VPU never
-touches 64-bit global ids; the mapping back to global ids happens on the host
-in ``reduce``.
+TPU note: labels are GLOBAL PADDED vertex indices (i32) on device — small,
+mesh-consistent, and never 64-bit external ids; ``view.vids[label]`` recovers
+the external id of a component's representative when needed.
 """
 
 from __future__ import annotations
@@ -31,8 +31,7 @@ class ConnectedComponents(VertexProgram):
     direction = "both"
 
     def init(self, ctx: Context):
-        idx = jnp.arange(ctx.n, dtype=jnp.int32)
-        return jnp.where(ctx.v_mask, idx, _I32_MAX)
+        return jnp.where(ctx.v_mask, ctx.global_index(), _I32_MAX)
 
     def message(self, src_state, edge: Edges):
         return src_state

@@ -36,8 +36,9 @@ class PageRank(VertexProgram):
 
     def update(self, state, agg, ctx: Context):
         n = jnp.maximum(ctx.num_vertices, 1.0)
-        # dangling vertices redistribute their mass uniformly
-        dangling = jnp.sum(
+        # dangling vertices redistribute their mass uniformly (global scalar —
+        # a psum across shards when running on a mesh)
+        dangling = ctx.global_sum(
             jnp.where(ctx.v_mask & (ctx.out_deg == 0), state["rank"], 0.0)
         )
         new = (1.0 - self.damping) / n + self.damping * (agg + dangling / n)

@@ -33,15 +33,9 @@ def _merge_aggs(op: str, a, b):
     return jax.tree_util.tree_map(_elem[op], a, b)
 
 
-@functools.lru_cache(maxsize=256)
-def _compiled_runner(program: VertexProgram, n: int, m: int, k: int,
-                     prop_keys: tuple, vprop_keys: tuple):
-    """One compiled program per (algorithm instance, padded shapes, #windows).
-
-    Range sweeps at the same bucketed shape hit this cache — the amortisation
-    the reference never had (fresh handshake per hop,
-    ``RangeAnalysisTask.scala:18-35``).
-    """
+def make_runner(program: VertexProgram, n: int, m: int, k: int):
+    """The raw (unjitted) superstep program for given padded shapes — the
+    jittable forward step of the framework; see also ``__graft_entry__``."""
 
     def one_superstep(state, v_mask, e_mask, out_deg, in_deg, ctx, edges):
         agg = None
@@ -122,7 +116,19 @@ def _compiled_runner(program: VertexProgram, n: int, m: int, k: int,
         result = jax.vmap(fin_k, in_axes=(0, 0))(jnp.arange(k), state)
         return result, steps
 
-    return jax.jit(run)
+    return run
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_runner(program: VertexProgram, n: int, m: int, k: int,
+                     prop_keys: tuple, vprop_keys: tuple):
+    """One compiled program per (algorithm instance, padded shapes, #windows).
+
+    Range sweeps at the same bucketed shape hit this cache — the amortisation
+    the reference never had (fresh handshake per hop,
+    ``RangeAnalysisTask.scala:18-35``).
+    """
+    return jax.jit(make_runner(program, n, m, k))
 
 
 def _gather_props(view: GraphView, keys, kind: str):
@@ -148,6 +154,8 @@ def run(
                                 (BWindowed*; leading axis on the result).
     """
     batched = windows is not None
+    if windows is not None and len(windows) == 0:
+        raise ValueError("windows must be a non-empty list of window sizes")
     if windows is None:
         windows = [window if window is not None else -1]
     wlist = list(windows)

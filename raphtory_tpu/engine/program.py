@@ -29,6 +29,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 
 
@@ -36,10 +37,14 @@ import jax.numpy as jnp
 class Edges:
     """Per-edge arrays visible to ``message`` (masked rows are neutralised by
     the engine). ``time``/``first_time`` are the latest/earliest history
-    points — the temporal columns that power time-aware algorithms."""
+    points — the temporal columns that power time-aware algorithms.
 
-    src: jnp.ndarray          # i32[m] local source index
-    dst: jnp.ndarray          # i32[m] local destination index
+    ``src``/``dst`` are GLOBAL padded vertex indices in every engine (on a
+    single device global == local). Programs may compare them (e.g. drop
+    self-loops) but must not index local per-shard arrays with them."""
+
+    src: jnp.ndarray          # i32[m] global padded source index
+    dst: jnp.ndarray          # i32[m] global padded destination index
     mask: jnp.ndarray         # bool[m] (already window-restricted)
     time: jnp.ndarray         # i64[m] latest activity <= T
     first_time: jnp.ndarray   # i64[m]
@@ -55,23 +60,46 @@ class Context:
     is just arrays.
     """
 
-    n: int                    # padded vertex count (static)
+    n: int                    # LOCAL padded vertex count (static; = global on 1 device)
     time: jnp.ndarray         # i64 scalar: view timestamp
     window: jnp.ndarray       # i64 scalar: window size (-1 = none)
-    v_mask: jnp.ndarray       # bool[n] in-view/in-window vertices
+    v_mask: jnp.ndarray       # bool[n] in-view/in-window vertices (local rows)
     vids: jnp.ndarray         # i64[n] global ids (-1 pad)
     v_latest_time: jnp.ndarray
     v_first_time: jnp.ndarray
     out_deg: jnp.ndarray      # i32[n] under current mask
     in_deg: jnp.ndarray       # i32[n]
-    n_active: jnp.ndarray     # i32 scalar: |v_mask|
+    n_active: jnp.ndarray     # i32 scalar: GLOBAL active vertex count
     step: jnp.ndarray         # i32 scalar: current superstep
     vprops: dict[str, jnp.ndarray]
+    # Sharding context. On a sharded mesh, a program sees only its device's
+    # rows; `v_offset` is the global index of local row 0 and `axis_name` the
+    # mesh axis for cross-shard reductions. Programs that need global scalars
+    # (e.g. PageRank's dangling mass) MUST use ctx.global_sum — on one device
+    # it degrades to a plain jnp.sum.
+    v_offset: jnp.ndarray = 0      # i32 scalar
+    axis_name: str | None = None   # static
 
     @property
     def num_vertices(self) -> jnp.ndarray:
-        """Active vertex count as f32 (handy for PageRank-style normalisers)."""
+        """GLOBAL active vertex count as f32 (PageRank-style normalisers)."""
         return self.n_active.astype(jnp.float32)
+
+    def global_sum(self, x: jnp.ndarray) -> jnp.ndarray:
+        s = jnp.sum(x)
+        if self.axis_name is not None:
+            s = jax.lax.psum(s, self.axis_name)
+        return s
+
+    def global_max(self, x: jnp.ndarray) -> jnp.ndarray:
+        s = jnp.max(x)
+        if self.axis_name is not None:
+            s = jax.lax.pmax(s, self.axis_name)
+        return s
+
+    def global_index(self) -> jnp.ndarray:
+        """i32[n]: global padded index of each local row (CC labels etc.)."""
+        return jnp.asarray(self.v_offset, jnp.int32) + jnp.arange(self.n, dtype=jnp.int32)
 
 
 class VertexProgram:

@@ -8,9 +8,15 @@ device mesh, per SURVEY.md §4.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# The image ships JAX_PLATFORMS=axon (one real TPU chip) AND a sitecustomize
+# that imports jax at interpreter startup — so env vars are already consumed
+# by the time conftest runs. Reconfigure jax in-process instead: tests run on
+# an 8-device virtual CPU mesh (backends are lazy; first jax.devices() call
+# happens inside the tests).
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests may spawn
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

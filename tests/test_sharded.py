@@ -1,0 +1,112 @@
+"""Sharded engine vs single-device engine equivalence on a CPU-simulated
+8-device mesh (multi-node-without-a-cluster, SURVEY §4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from raphtory_tpu import EventLog, build_view
+from raphtory_tpu.algorithms import ConnectedComponents, PageRank
+from raphtory_tpu.engine import bsp
+from raphtory_tpu.parallel import sharded
+
+
+def _random_log(seed, n_ids=60, n_events=500, t_max=100):
+    rng = np.random.default_rng(seed)
+    log = EventLog()
+    for _ in range(n_events):
+        t = int(rng.integers(0, t_max))
+        a, b = (int(x) for x in rng.integers(0, n_ids, 2))
+        r = rng.random()
+        if r < 0.55:
+            log.add_edge(t, a, b)
+        elif r < 0.7:
+            log.add_vertex(t, a)
+        elif r < 0.85:
+            log.delete_edge(t, a, b)
+        else:
+            log.delete_vertex(t, a)
+    return log
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    assert len(jax.devices()) >= 8, "conftest must force 8 virtual devices"
+    return jax.devices()[:8]
+
+
+def _cc_partition(labels, mask):
+    labels = np.asarray(labels)
+    return {
+        frozenset(np.flatnonzero((labels == l) & mask).tolist())
+        for l in np.unique(labels[mask])
+    }
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_cc_sharded_matches_single(seed, eight_devices):
+    log = _random_log(seed)
+    view = build_view(log, 90)
+    mesh = sharded.make_mesh(8, 1, devices=eight_devices)
+    got, _ = sharded.run(ConnectedComponents(), view, mesh)
+    want, _ = bsp.run(ConnectedComponents(), view)
+    assert _cc_partition(got, view.v_mask) == _cc_partition(want, view.v_mask)
+
+
+def test_pagerank_sharded_matches_single(eight_devices):
+    log = _random_log(2)
+    view = build_view(log, 95)
+    mesh = sharded.make_mesh(8, 1, devices=eight_devices)
+    prog = PageRank(max_steps=40, tol=0.0)
+    got, _ = sharded.run(prog, view, mesh)
+    want, _ = bsp.run(prog, view)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_windowed_batch_on_2d_mesh(eight_devices):
+    """windows axis x vertices axis: 2x4 mesh, 4 windows."""
+    log = _random_log(3)
+    view = build_view(log, 90)
+    mesh = sharded.make_mesh(4, 2, devices=eight_devices)
+    windows = [200, 50, 20, 5]
+    got, _ = sharded.run(ConnectedComponents(), view, mesh, windows=windows)
+    want, _ = bsp.run(ConnectedComponents(), view, windows=windows)
+    for i, w in enumerate(windows):
+        vm, _ = view.window_masks([w])
+        assert _cc_partition(np.asarray(got)[i], vm[0]) == _cc_partition(
+            np.asarray(want)[i], vm[0]
+        ), f"window {w}"
+
+
+def test_window_count_not_multiple_of_axis(eight_devices):
+    log = _random_log(4)
+    view = build_view(log, 90)
+    mesh = sharded.make_mesh(4, 2, devices=eight_devices)
+    windows = [100, 30, 7]  # 3 windows on a 2-wide window axis
+    got, _ = sharded.run(ConnectedComponents(), view, mesh, windows=windows)
+    want, _ = bsp.run(ConnectedComponents(), view, windows=windows)
+    assert np.asarray(got).shape[0] == 3
+    for i, w in enumerate(windows):
+        vm, _ = view.window_masks([w])
+        assert _cc_partition(np.asarray(got)[i], vm[0]) == _cc_partition(
+            np.asarray(want)[i], vm[0]
+        )
+
+
+def test_pagerank_windowed_sharded(eight_devices):
+    log = _random_log(5)
+    view = build_view(log, 95)
+    mesh = sharded.make_mesh(8, 1, devices=eight_devices)
+    prog = PageRank(max_steps=30, tol=0.0)
+    got, _ = sharded.run(prog, view, mesh, window=40)
+    want, _ = bsp.run(prog, view, window=40)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_single_device_mesh_degenerate(eight_devices):
+    log = _random_log(6, n_ids=20, n_events=100)
+    view = build_view(log, 90)
+    mesh = sharded.make_mesh(1, 1, devices=eight_devices[:1])
+    got, _ = sharded.run(ConnectedComponents(), view, mesh)
+    want, _ = bsp.run(ConnectedComponents(), view)
+    assert _cc_partition(got, view.v_mask) == _cc_partition(want, view.v_mask)
