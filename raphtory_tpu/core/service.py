@@ -1,0 +1,82 @@
+"""TemporalGraph — the user-facing handle tying log, ingestion and views.
+
+The single-process equivalent of the whole reference deployment
+(``SingleNodeSetup.scala``): storage + ingestion + analysis access behind one
+object. The watermark fence reproduces the ``TimeCheck``/``TimeResponse``
+gate (``AnalysisTask.scala:162-195``): a view at T is only served as *exact*
+once every source's watermark has passed T; otherwise the caller opts into
+waiting or a best-effort (live) view.
+"""
+
+from __future__ import annotations
+
+import collections
+import time as _time
+
+from ..ingestion.watermark import WatermarkRegistry
+from .events import EventLog
+from .snapshot import GraphView, build_view
+
+
+class StaleViewError(RuntimeError):
+    pass
+
+
+class TemporalGraph:
+    def __init__(self, log: EventLog | None = None,
+                 watermarks: WatermarkRegistry | None = None,
+                 cache_size: int = 8):
+        self.log = log if log is not None else EventLog()
+        self.watermarks = watermarks if watermarks is not None else WatermarkRegistry()
+        self._cache: collections.OrderedDict = collections.OrderedDict()
+        self._cache_size = cache_size
+
+    # ---- time bounds ----
+
+    @property
+    def earliest_time(self) -> int:
+        return self.log.min_time
+
+    @property
+    def latest_time(self) -> int:
+        return self.log.max_time
+
+    def safe_time(self) -> int:
+        """Largest timestamp no in-flight source can still mutate."""
+        return min(self.watermarks.safe_time(), 2**62)
+
+    # ---- views (the GraphLens surface) ----
+
+    def view_at(self, time: int, *, exact: bool = True,
+                wait_timeout: float = 0.0,
+                include_occurrences: bool = False) -> GraphView:
+        """Snapshot at `time`. exact=True enforces the watermark fence,
+        optionally polling up to wait_timeout seconds (the reference re-checks
+        every 10 s — AnalysisTask.scala:183-189); exact=False serves a
+        best-effort live view."""
+        if exact:
+            deadline = _time.monotonic() + wait_timeout
+            while self.safe_time() < time:
+                if _time.monotonic() >= deadline:
+                    raise StaleViewError(
+                        f"view at {time} not yet safe: watermark="
+                        f"{self.safe_time()} ({self.watermarks.snapshot()})")
+                _time.sleep(min(0.05, wait_timeout))
+        key = (self.log.version, int(time), include_occurrences)
+        hit = self._cache.get(key)
+        if hit is not None:
+            self._cache.move_to_end(key)
+            return hit
+        view = build_view(self.log, int(time),
+                          include_occurrences=include_occurrences)
+        self._cache[key] = view
+        while len(self._cache) > self._cache_size:
+            self._cache.popitem(last=False)
+        return view
+
+    def live_view(self, include_occurrences: bool = False) -> GraphView:
+        """View at the current safe watermark (LiveAnalysisTask semantics:
+        timestamp = min over workers' watermarks, LiveAnalysisTask.scala:55-105)."""
+        t = min(self.safe_time(), self.latest_time)
+        return self.view_at(t, exact=False,
+                            include_occurrences=include_occurrences)

@@ -1,0 +1,100 @@
+"""Parsers ("routers") — raw tuples → typed graph updates.
+
+``RouterWorker`` analogue (``Router/RouterWorker.scala:33`` —
+``parseTuple`` is THE user extension point; e.g. ``GabUserGraphRouter``
+turns a CSV row into a user↔user edge, ``LDBCRouter`` handles deletes).
+A parser is a callable returning zero or more GraphUpdates per tuple.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .updates import EdgeAdd, EdgeDelete, GraphUpdate, VertexAdd, VertexDelete
+
+
+class Parser:
+    def __call__(self, raw) -> list[GraphUpdate]:
+        raise NotImplementedError
+
+
+class IdentityParser(Parser):
+    """For sources that already yield GraphUpdates (RandomSource)."""
+
+    def __call__(self, raw):
+        return [raw]
+
+
+class CsvEdgeListParser(Parser):
+    """`src,dst,time`-style rows → EdgeAdd. Column order/separator/time scale
+    configurable; the shape of most example routers."""
+
+    def __init__(self, sep: str = ",", src_col: int = 0, dst_col: int = 1,
+                 time_col: int = 2, time_scale: int = 1, props_cols: dict | None = None):
+        self.sep = sep
+        self.src_col = src_col
+        self.dst_col = dst_col
+        self.time_col = time_col
+        self.time_scale = time_scale
+        self.props_cols = props_cols or {}
+
+    def __call__(self, raw: str):
+        parts = raw.split(self.sep)
+        props = None
+        if self.props_cols:
+            props = {}
+            for name, col in self.props_cols.items():
+                try:
+                    props[name] = float(parts[col])
+                except (ValueError, IndexError):
+                    pass
+        return [EdgeAdd(
+            time=int(float(parts[self.time_col])) * self.time_scale,
+            src=parts[self.src_col].strip(),
+            dst=parts[self.dst_col].strip(),
+            props=props,
+        )]
+
+
+class GabParser(Parser):
+    """The README demo dataset: gab.ai post CSV, user↔parent-user reply edges
+    with epoch-seconds conversion (``GabUserGraphRouter.scala:239-256``:
+    columns include timestamp, user id, parent user id; self-replies kept)."""
+
+    def __init__(self, time_col: int = 0, src_col: int = 2, dst_col: int = 5,
+                 sep: str = ";"):
+        self.time_col = time_col
+        self.src_col = src_col
+        self.dst_col = dst_col
+        self.sep = sep
+
+    def __call__(self, raw: str):
+        parts = raw.split(self.sep)
+        try:
+            t = int(parts[self.time_col])
+            src = int(parts[self.src_col])
+            dst = int(parts[self.dst_col])
+        except (ValueError, IndexError):
+            return []  # malformed row — reference routers drop these too
+        return [EdgeAdd(time=t, src=src, dst=dst)]
+
+
+class JsonUpdateParser(Parser):
+    """The RandomSpout JSON protocol (``RandomRouter.scala:142-213``):
+    {"type": "vertexAdd"|"edgeAdd"|..., "t": ..., "src": ..., "dst": ...,
+    "props": {...}} one object per line."""
+
+    def __call__(self, raw: str):
+        o = json.loads(raw)
+        kind = o.get("type")
+        t = int(o["t"])
+        props = o.get("props")
+        if kind == "vertexAdd":
+            return [VertexAdd(t, o["id"], props)]
+        if kind == "vertexDelete":
+            return [VertexDelete(t, o["id"])]
+        if kind == "edgeAdd":
+            return [EdgeAdd(t, o["src"], o["dst"], props)]
+        if kind == "edgeDelete":
+            return [EdgeDelete(t, o["src"], o["dst"])]
+        raise ValueError(f"unknown update type {kind!r}")

@@ -1,0 +1,121 @@
+"""Sources ("spouts") — pluggable raw-tuple producers.
+
+``SpoutTrait`` analogue (``core/components/Spout/SpoutTrait.scala``): the
+reference's spouts poll external systems (files, Kafka, JSON-RPC, Mongo) and
+emit raw strings downstream; subclasses override one method. Here a source is
+an iterator of raw tuples plus an optional out-of-orderness bound used for
+watermarking. Rate control (the paper's ramp protocol) is a wrapper, not
+baked into each source.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time as _time
+from collections.abc import Iterable, Iterator
+
+
+class Source:
+    """Base: iterate raw tuples. ``disorder`` bounds how far behind the max
+    emitted event-time a later tuple may be (0 = time-ordered stream);
+    the pipeline uses it to hold back the source watermark."""
+
+    name = "source"
+    disorder: int = 0
+
+    def __iter__(self) -> Iterator:
+        raise NotImplementedError
+
+
+class IterableSource(Source):
+    def __init__(self, items: Iterable, name: str = "iterable", disorder: int = 0):
+        self._items = items
+        self.name = name
+        self.disorder = disorder
+
+    def __iter__(self):
+        return iter(self._items)
+
+
+class FileSource(Source):
+    """Line replay of a file — the ``GabExampleSpout`` pattern
+    (``GabExampleSpout.scala:201-218`` reads a CSV 100 lines per tick)."""
+
+    def __init__(self, path: str, name: str | None = None, disorder: int = 0,
+                 skip_header: bool = False):
+        self.path = path
+        self.name = name or path
+        self.disorder = disorder
+        self.skip_header = skip_header
+
+    def __iter__(self):
+        with open(self.path) as f:
+            it = iter(f)
+            if self.skip_header:
+                next(it, None)
+            for line in it:
+                line = line.rstrip("\n")
+                if line:
+                    yield line
+
+
+class RandomSource(Source):
+    """The paper's synthetic stress workload (``RandomSpout.scala:27-59``):
+    parameterised add/delete mix over a bounded ID pool. Yields GraphUpdate
+    objects directly (its parser is the identity)."""
+
+    def __init__(self, n_events: int, id_pool: int = 1_000_000, seed: int = 0,
+                 mix=(0.3, 0.7, 0.0, 0.0), name: str = "random"):
+        self.n_events = n_events
+        self.id_pool = id_pool
+        self.seed = seed
+        self.mix = mix
+        self.name = name
+        self.disorder = 0
+
+    def __iter__(self):
+        from ..core import events as ev
+        from ..utils.synth import random_update_stream
+        from .updates import EdgeAdd, EdgeDelete, VertexAdd, VertexDelete
+
+        t, k, s, d = random_update_stream(
+            self.n_events, self.id_pool, self.seed, mix=self.mix)
+        for i in range(len(t)):
+            ti, ki = int(t[i]), int(k[i])
+            if ki == int(ev.VERTEX_ADD):
+                yield VertexAdd(ti, int(s[i]))
+            elif ki == int(ev.EDGE_ADD):
+                yield EdgeAdd(ti, int(s[i]), int(d[i]))
+            elif ki == int(ev.VERTEX_DELETE):
+                yield VertexDelete(ti, int(s[i]))
+            else:
+                yield EdgeDelete(ti, int(s[i]), int(d[i]))
+
+
+class RateLimited(Source):
+    """Wrap a source with a msgs/sec cap, optionally ramping (+step msgs/sec
+    every interval) — the paper's load-ramp protocol (§6.1: +1,000 msgs/s per
+    minute)."""
+
+    def __init__(self, inner: Source, rate: float, ramp_step: float = 0.0,
+                 ramp_interval_s: float = 60.0):
+        self.inner = inner
+        self.rate = rate
+        self.ramp_step = ramp_step
+        self.ramp_interval_s = ramp_interval_s
+        self.name = f"ratelimited({inner.name})"
+        self.disorder = inner.disorder
+
+    def __iter__(self):
+        rate = self.rate
+        t0 = _time.monotonic()
+        sent = 0
+        for item in self.inner:
+            yield item
+            sent += 1
+            now = _time.monotonic()
+            if self.ramp_step:
+                rate = self.rate + self.ramp_step * int((now - t0) / self.ramp_interval_s)
+            ahead = sent / rate - (now - t0)
+            if ahead > 0:
+                _time.sleep(min(ahead, 0.25))

@@ -1,0 +1,68 @@
+"""Typed graph updates — the wire protocol of the ingestion layer.
+
+Parity with the reference's update message algebra
+(``raphtoryMessages.scala:38-55``: VertexAdd[WithProperties], VertexDelete,
+EdgeAdd[WithProperties], EdgeDelete — the ``Tracked*`` wrappers carrying
+(routerID, messageID) for watermarking are replaced by per-source sequence
+counting in the pipeline). String entity keys are hashed to stable i64 ids
+like ``RouterWorker.assignID``'s MurmurHash3 (``RouterWorker.scala:75``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+
+def assign_id(key: str | int) -> int:
+    """Stable string→i64 id (blake2b-64; deterministic across runs/hosts)."""
+    if isinstance(key, int):
+        return key
+    h = hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest()
+    return int.from_bytes(h, "little", signed=True)
+
+
+@dataclass(frozen=True)
+class VertexAdd:
+    time: int
+    vid: int | str
+    props: dict | None = None
+
+
+@dataclass(frozen=True)
+class VertexDelete:
+    time: int
+    vid: int | str
+
+
+@dataclass(frozen=True)
+class EdgeAdd:
+    time: int
+    src: int | str
+    dst: int | str
+    props: dict | None = None
+
+
+@dataclass(frozen=True)
+class EdgeDelete:
+    time: int
+    src: int | str
+    dst: int | str
+
+
+GraphUpdate = VertexAdd | VertexDelete | EdgeAdd | EdgeDelete
+
+
+def apply_update(log, u: GraphUpdate) -> int:
+    """Apply one update to an EventLog; returns the event time."""
+    if isinstance(u, VertexAdd):
+        log.add_vertex(u.time, assign_id(u.vid), u.props)
+    elif isinstance(u, VertexDelete):
+        log.delete_vertex(u.time, assign_id(u.vid))
+    elif isinstance(u, EdgeAdd):
+        log.add_edge(u.time, assign_id(u.src), assign_id(u.dst), u.props)
+    elif isinstance(u, EdgeDelete):
+        log.delete_edge(u.time, assign_id(u.src), assign_id(u.dst))
+    else:  # pragma: no cover
+        raise TypeError(f"not a GraphUpdate: {u!r}")
+    return u.time

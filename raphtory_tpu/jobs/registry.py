@@ -1,0 +1,62 @@
+"""Algorithm registry — the reflection/runtime-compilation surface.
+
+The reference resolves analysers by ``Class.forName`` and, failing that,
+compiles Scala source shipped in the REST payload with a ToolBox
+(``AnalysisManager.scala:192-213``, ``Analyser.scala:23-28``). Here:
+a name registry for built-ins + plain-Python dynamic definitions ("dynamic
+analyser" = a Python snippet defining ``program``), no compiler machinery.
+"""
+
+from __future__ import annotations
+
+from ..engine.program import VertexProgram
+
+_REGISTRY: dict[str, type] = {}
+_BUILTINS_LOADED = False
+
+
+def register(name: str | None = None):
+    def deco(cls):
+        _REGISTRY[name or cls.__name__] = cls
+        return cls
+    return deco
+
+
+def names() -> list[str]:
+    _ensure_builtins()
+    return sorted(_REGISTRY)
+
+
+def resolve(name: str, params: dict | None = None) -> VertexProgram:
+    """Instantiate a registered program by name with hyperparams."""
+    _ensure_builtins()
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        raise KeyError(
+            f"unknown analyser {name!r}; registered: {sorted(_REGISTRY)}")
+    return cls(**(params or {}))
+
+
+def compile_source(source: str) -> VertexProgram:
+    """Dynamic analyser: exec Python source that binds ``program`` (the
+    LoadExternalAnalyser capability — the reference accepts raw analyser
+    source over REST, ``AnalysisRestApi`` rawFile field). Runs with full
+    interpreter privileges, exactly like the reference's ToolBox compile;
+    deployments that do not want this must not expose the REST port."""
+    ns: dict = {}
+    exec(source, ns)  # noqa: S102 — capability parity with reference
+    prog = ns.get("program")
+    if prog is None:
+        raise ValueError("dynamic analyser source must define `program`")
+    return prog
+
+
+def _ensure_builtins() -> None:
+    global _BUILTINS_LOADED
+    if _BUILTINS_LOADED:
+        return
+    from .. import algorithms as A
+
+    for nm in A.__all__:
+        _REGISTRY.setdefault(nm, getattr(A, nm))
+    _BUILTINS_LOADED = True

@@ -1,0 +1,134 @@
+"""REST job API — wire-compatible surface with the reference.
+
+``AnalysisRestApi.scala`` serves on :8081 (line 30): POST
+``/LiveAnalysisRequest`` ``/ViewAnalysisRequest`` ``/RangeAnalysisRequest``
+and GET ``/AnalysisResults?jobID=`` ``/KillTask?jobID=`` (lines 35-129).
+Same five endpoints here on a stdlib ThreadingHTTPServer (no web-framework
+dependency). Request bodies take the reference's field names
+(analyserName, timestamp, start/end/jump, windowType, windowSize, windowSet,
+repeatTime, rawFile) with `params` as an extension for hyperparameters.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import registry
+from .manager import AnalysisManager, LiveQuery, RangeQuery, ViewQuery
+
+DEFAULT_PORT = 8081
+
+
+def _windows_from(body: dict):
+    """windowType: 'none' | 'single' | 'batched' (the reference's 3-way task
+    split per query type)."""
+    wt = body.get("windowType", "none")
+    if wt in ("none", "false", None):
+        return None, None
+    if wt in ("single", "true"):
+        return int(body["windowSize"]), None
+    if wt == "batched":
+        return None, tuple(int(w) for w in body["windowSet"])
+    raise ValueError(f"unknown windowType {wt!r}")
+
+
+def _program_from(body: dict):
+    if body.get("rawFile"):
+        return registry.compile_source(body["rawFile"])
+    return registry.resolve(body["analyserName"], body.get("params"))
+
+
+class _Handler(BaseHTTPRequestHandler):
+    manager: AnalysisManager = None  # injected by serve()
+    allow_dynamic: bool = True
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _json(self, code: int, payload) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self):
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            path = self.path.rstrip("/")
+            if path not in ("/ViewAnalysisRequest", "/RangeAnalysisRequest",
+                            "/LiveAnalysisRequest"):
+                return self._json(404, {"error": f"unknown path {self.path}"})
+            if body.get("rawFile") and not self.allow_dynamic:
+                return self._json(403, {"error": "dynamic analysers disabled"})
+            window, windows = _windows_from(body)
+            program = _program_from(body)
+            if path == "/ViewAnalysisRequest":
+                q = ViewQuery(int(body["timestamp"]), window, windows)
+            elif path == "/RangeAnalysisRequest":
+                q = RangeQuery(int(body["start"]), int(body["end"]),
+                               int(body["jump"]), window, windows)
+            elif path == "/LiveAnalysisRequest":
+                max_runs = body.get("maxRuns")
+                q = LiveQuery(float(body.get("repeatTime", 1.0)),
+                              bool(body.get("eventTime", False)),
+                              int(max_runs) if max_runs is not None else None,
+                              window, windows)
+            else:
+                return self._json(404, {"error": f"unknown path {self.path}"})
+            job = self.manager.submit(program, q, job_id=body.get("jobID"))
+            self._json(200, {"jobID": job.id, "status": job.status})
+        except (KeyError, ValueError, TypeError) as e:
+            self._json(400, {"error": f"{type(e).__name__}: {e}"})
+        except Exception as e:  # noqa: BLE001
+            self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def do_GET(self):
+        try:
+            parsed = urllib.parse.urlparse(self.path)
+            qs = urllib.parse.parse_qs(parsed.query)
+            path = parsed.path.rstrip("/")
+            if path == "/AnalysisResults":
+                job = self.manager.get(qs["jobID"][0])
+                return self._json(200, {
+                    "jobID": job.id, "status": job.status,
+                    "error": job.error, "results": job.results,
+                })
+            if path == "/KillTask":
+                self.manager.kill(qs["jobID"][0])
+                return self._json(200, {"jobID": qs["jobID"][0],
+                                        "status": "killed"})
+            if path == "/Jobs":
+                return self._json(200, self.manager.jobs())
+            if path == "/Analysers":
+                return self._json(200, registry.names())
+            return self._json(404, {"error": f"unknown path {self.path}"})
+        except KeyError as e:
+            self._json(404, {"error": f"KeyError: {e}"})
+        except Exception as e:  # noqa: BLE001
+            self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+class RestServer:
+    def __init__(self, manager: AnalysisManager, port: int = DEFAULT_PORT,
+                 host: str = "127.0.0.1", allow_dynamic: bool = True):
+        handler = type("Handler", (_Handler,),
+                       {"manager": manager, "allow_dynamic": allow_dynamic})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "RestServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="rest", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,154 @@
+"""Ingestion pipeline, parsers, watermark fence."""
+
+import numpy as np
+import pytest
+
+from raphtory_tpu.core.service import StaleViewError, TemporalGraph
+from raphtory_tpu.ingestion.parser import (
+    CsvEdgeListParser,
+    GabParser,
+    JsonUpdateParser,
+)
+from raphtory_tpu.ingestion.pipeline import IngestionPipeline
+from raphtory_tpu.ingestion.source import (
+    FileSource,
+    IterableSource,
+    RandomSource,
+    RateLimited,
+)
+from raphtory_tpu.ingestion.updates import EdgeAdd, VertexDelete, assign_id
+
+
+def test_csv_parser_pipeline(tmp_path):
+    p = tmp_path / "edges.csv"
+    p.write_text("a,b,1\nb,c,2\na,c,3\n")
+    pipe = IngestionPipeline()
+    pipe.add_source(FileSource(str(p)), CsvEdgeListParser())
+    pipe.run()
+    assert pipe.counts[str(p)] == 3
+    g = TemporalGraph(pipe.log, pipe.watermarks)
+    v = g.view_at(3)
+    assert v.n_active == 3 and v.m_active == 3
+    # string ids resolved through assign_id
+    li = v.local_index([assign_id("a")])
+    assert li[0] >= 0
+    assert v.out_deg[li[0]] == 2
+
+
+def test_gab_parser():
+    par = GabParser()
+    rows = par("1470000000;x;101;y;z;202")
+    assert rows == [EdgeAdd(time=1470000000, src=101, dst=202)]
+    assert par("garbage;;row") == []
+
+
+def test_json_parser():
+    par = JsonUpdateParser()
+    u = par('{"type": "edgeAdd", "t": 5, "src": 1, "dst": 2}')
+    assert u == [EdgeAdd(5, 1, 2)]
+    u = par('{"type": "vertexDelete", "t": 9, "id": 4}')
+    assert u == [VertexDelete(9, 4)]
+    with pytest.raises(ValueError):
+        par('{"type": "nope", "t": 1}')
+
+
+def test_random_source_runs_and_counts():
+    pipe = IngestionPipeline()
+    pipe.add_source(RandomSource(5_000, id_pool=500, seed=1))
+    pipe.run()
+    assert pipe.log.n == 5_000
+    g = TemporalGraph(pipe.log, pipe.watermarks)
+    v = g.view_at(g.latest_time)
+    assert v.n_active > 0
+
+
+def test_watermark_fence_blocks_until_source_passes():
+    pipe = IngestionPipeline(batch_size=10)
+    g = TemporalGraph(pipe.log, pipe.watermarks)
+    src = IterableSource([EdgeAdd(t, 1, 2) for t in range(100)], name="s")
+    pipe.add_source(src)
+    # nothing ingested yet: view at 50 must refuse
+    with pytest.raises(StaleViewError):
+        g.view_at(50)
+    pipe.run()
+    v = g.view_at(50)  # source finished -> fence open
+    assert v.m_active == 1
+
+
+def test_watermark_disorder_bound():
+    pipe = IngestionPipeline(batch_size=4)
+    g = TemporalGraph(pipe.log, pipe.watermarks)
+
+    def gen():
+        for t in range(0, 100):
+            yield EdgeAdd(t, t, t + 1)
+
+    src = IterableSource(gen(), name="s", disorder=20)
+    pipe.add_source(src)
+    pipe.start()
+    pipe.join()
+    # finished -> safe regardless of disorder
+    assert g.safe_time() >= 99
+    assert pipe.log.n == 100
+
+
+def test_live_threaded_ingestion_with_fence():
+    import itertools
+    import threading
+
+    gate = threading.Event()
+
+    def slow():
+        for t in range(0, 200):
+            if t == 100:
+                gate.wait(5)
+            yield EdgeAdd(t, t % 10, (t + 1) % 10)
+
+    pipe = IngestionPipeline(batch_size=8)
+    g = TemporalGraph(pipe.log, pipe.watermarks)
+    pipe.add_source(IterableSource(slow(), name="slow"))
+    pipe.start()
+    # watermark advances past some prefix but not to the end
+    import time
+    deadline = time.monotonic() + 5
+    while g.safe_time() < 50 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert 50 <= g.safe_time() < 2**62
+    with pytest.raises(StaleViewError):
+        g.view_at(10**9)
+    gate.set()
+    pipe.join(5)
+    assert g.safe_time() >= 199
+    v = g.view_at(199)
+    assert v.n_active == 10
+
+
+def test_view_cache_reuse_and_invalidation():
+    pipe = IngestionPipeline()
+    g = TemporalGraph(pipe.log, pipe.watermarks)
+    pipe.add_source(IterableSource([EdgeAdd(1, 1, 2)], name="a"))
+    pipe.run()
+    v1 = g.view_at(1)
+    assert g.view_at(1) is v1  # cache hit
+    g.log.add_edge(2, 2, 3)   # append invalidates (version bump)
+    v2 = g.view_at(1)
+    assert v2 is not v1
+
+
+def test_rate_limited_wrapper():
+    import time
+
+    src = RateLimited(
+        IterableSource([EdgeAdd(t, 1, 2) for t in range(50)], name="x"),
+        rate=1000.0)
+    t0 = time.monotonic()
+    items = list(src)
+    assert len(items) == 50
+    assert time.monotonic() - t0 >= 0.04  # ~50/1000s floor
+
+
+def test_assign_id_stability():
+    a1 = assign_id("alice")
+    assert a1 == assign_id("alice")
+    assert a1 != assign_id("bob")
+    assert assign_id(42) == 42
