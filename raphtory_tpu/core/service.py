@@ -11,6 +11,7 @@ waiting or a best-effort (live) view.
 from __future__ import annotations
 
 import collections
+import threading
 import time as _time
 
 from ..ingestion.watermark import WatermarkRegistry
@@ -30,6 +31,7 @@ class TemporalGraph:
         self.watermarks = watermarks if watermarks is not None else WatermarkRegistry()
         self._cache: collections.OrderedDict = collections.OrderedDict()
         self._cache_size = cache_size
+        self._cache_lock = threading.Lock()  # jobs share one graph
 
     # ---- time bounds ----
 
@@ -63,16 +65,42 @@ class TemporalGraph:
                         f"{self.safe_time()} ({self.watermarks.snapshot()})")
                 _time.sleep(min(0.05, wait_timeout))
         key = (self.log.version, int(time), include_occurrences)
-        hit = self._cache.get(key)
-        if hit is not None:
-            self._cache.move_to_end(key)
-            return hit
+        with self._cache_lock:
+            hit = self._cache.get(key)
+            if hit is not None:
+                self._cache.move_to_end(key)
+                return hit
         view = build_view(self.log, int(time),
                           include_occurrences=include_occurrences)
-        self._cache[key] = view
-        while len(self._cache) > self._cache_size:
-            self._cache.popitem(last=False)
+        with self._cache_lock:
+            self._cache[key] = view
+            while len(self._cache) > self._cache_size:
+                self._cache.popitem(last=False)
         return view
+
+    # ---- maintenance ----
+
+    def swap_log(self, new_log: EventLog) -> None:
+        """Replace the log object; invalidates the view cache. NOTE: any
+        ingestion pipeline holding the old log keeps writing there — prefer
+        ``EventLog.compact_to`` (in-place) for live graphs."""
+        self.log = new_log
+        self.invalidate_cache()
+
+    def invalidate_cache(self) -> None:
+        with self._cache_lock:
+            self._cache.clear()
+
+    def checkpoint(self, path: str) -> None:
+        from ..persist.checkpoint import save_log
+
+        save_log(self.log, path)
+
+    @classmethod
+    def restore(cls, path: str, **kw) -> "TemporalGraph":
+        from ..persist.checkpoint import load_log
+
+        return cls(log=load_log(path), **kw)
 
     def live_view(self, include_occurrences: bool = False) -> GraphView:
         """View at the current safe watermark (LiveAnalysisTask semantics:

@@ -84,7 +84,7 @@ def make_runner(program: VertexProgram, n: int, m: int, k: int):
         def step_k(kk, st, step):
             ctx = mk_ctx(kk, step)
             ek = Edges(src=e_src, dst=e_dst, mask=e_masks[kk], time=e_latest,
-                       first_time=e_first, props=eprops)
+                       first_time=e_first, props=eprops, step=step)
             return one_superstep(st, v_masks[kk], e_masks[kk],
                                  out_deg[kk], in_deg[kk], ctx, ek)
 
@@ -161,20 +161,42 @@ def run(
     wlist = list(windows)
     k = len(wlist)
 
+    # Occurrence-based temporal programs (EthereumTaintTracking-style) run
+    # over the multigraph of edge-add events rather than deduped edges —
+    # the analogue of iterating raw edge history via
+    # ``getOutgoingNeighborsAfter`` (VertexVisitor.scala:33).
+    if program.needs_occurrences:
+        if view.occ_src is None:
+            raise ValueError(
+                "program needs occurrences: build the view with "
+                "include_occurrences=True")
+        e_src, e_dst = view.occ_src, view.occ_dst
+        e_latest = e_first = view.occ_time
+        e_base_mask = view.occ_mask  # dst-sorted, like the deduped edges
+    else:
+        e_src, e_dst = view.e_src, view.e_dst
+        e_latest, e_first = view.e_latest_time, view.e_first_time
+        e_base_mask = view.e_mask
+    m_pad = len(e_src)
+
     v_masks = np.empty((k, view.n_pad), bool)
-    e_masks = np.empty((k, view.m_pad), bool)
+    e_masks = np.empty((k, m_pad), bool)
     for i, w in enumerate(wlist):
         if w is None or w < 0:
             v_masks[i] = view.v_mask
-            e_masks[i] = view.e_mask
+            e_masks[i] = e_base_mask
         else:
-            vm, em = view.window_masks([w])
-            v_masks[i], e_masks[i] = vm[0], em[0]
+            vm, _ = view.window_masks([w])
+            v_masks[i] = vm[0]
+            e_masks[i] = e_base_mask & (e_latest >= view.time - w)
 
     runner = _compiled_runner(
-        program, view.n_pad, view.m_pad, k,
+        program, view.n_pad, m_pad, k,
         tuple(program.edge_props), tuple(program.vertex_props),
     )
+    if program.needs_occurrences and program.edge_props:
+        raise NotImplementedError(
+            "edge_props on occurrence programs not yet supported")
     eprops = _gather_props(view, program.edge_props, "e")
     vprops = _gather_props(view, program.vertex_props, "v")
     win_arr = jnp.asarray([(-1 if w is None else int(w)) for w in wlist], jnp.int64)
@@ -183,8 +205,8 @@ def run(
         jnp.asarray(v_masks), jnp.asarray(e_masks),
         jnp.asarray(view.vids), jnp.asarray(view.v_latest_time),
         jnp.asarray(view.v_first_time),
-        jnp.asarray(view.e_src), jnp.asarray(view.e_dst),
-        jnp.asarray(view.e_latest_time), jnp.asarray(view.e_first_time),
+        jnp.asarray(e_src), jnp.asarray(e_dst),
+        jnp.asarray(e_latest), jnp.asarray(e_first),
         jnp.asarray(view.time, jnp.int64), win_arr, eprops, vprops,
     )
     if not batched:

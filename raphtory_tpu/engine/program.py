@@ -46,9 +46,12 @@ class Edges:
     src: jnp.ndarray          # i32[m] global padded source index
     dst: jnp.ndarray          # i32[m] global padded destination index
     mask: jnp.ndarray         # bool[m] (already window-restricted)
-    time: jnp.ndarray         # i64[m] latest activity <= T
+    time: jnp.ndarray         # i64[m] latest activity <= T (occurrence time
+                              #        for needs_occurrences programs)
     first_time: jnp.ndarray   # i64[m]
     props: dict[str, jnp.ndarray]   # f32[m] per requested key
+    step: jnp.ndarray = 0     # i32 scalar: current superstep (for
+                              # counter-based randomness etc.)
 
 
 @dataclass(frozen=True)

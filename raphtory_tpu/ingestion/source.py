@@ -10,7 +10,6 @@ baked into each source.
 
 from __future__ import annotations
 
-import itertools
 import time as _time
 from collections.abc import Iterable, Iterator
 

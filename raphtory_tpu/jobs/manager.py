@@ -12,11 +12,10 @@ snapshot cache are reused across hops).
 from __future__ import annotations
 
 import itertools
-import json
 import threading
 import time as _time
 import traceback
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..core.service import StaleViewError, TemporalGraph
 from ..engine import bsp
@@ -195,14 +194,16 @@ class AnalysisManager:
         self._lock = threading.Lock()
 
     def submit(self, program: VertexProgram, query: Query,
-               job_id: str | None = None, mesh=None) -> Job:
+               job_id: str | None = None, mesh=None,
+               wait_timeout: float = 30.0) -> Job:
         with self._lock:
             if job_id is None:
                 job_id = f"{type(program).__name__}_{next(self._counter)}"
             if job_id in self._jobs:
                 raise KeyError(f"job {job_id!r} already exists")
             job = Job(job_id, program, query, self.graph,
-                      mesh=mesh if mesh is not None else self.mesh)
+                      mesh=mesh if mesh is not None else self.mesh,
+                      wait_timeout=wait_timeout)
             self._jobs[job_id] = job
         return job.start()
 
@@ -219,4 +220,5 @@ class AnalysisManager:
         self.get(job_id).kill()
 
     def jobs(self) -> dict[str, str]:
-        return {jid: j.status for jid, j in self._jobs.items()}
+        with self._lock:
+            return {jid: j.status for jid, j in self._jobs.items()}

@@ -73,14 +73,12 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/RangeAnalysisRequest":
                 q = RangeQuery(int(body["start"]), int(body["end"]),
                                int(body["jump"]), window, windows)
-            elif path == "/LiveAnalysisRequest":
+            else:  # /LiveAnalysisRequest (path validated above)
                 max_runs = body.get("maxRuns")
                 q = LiveQuery(float(body.get("repeatTime", 1.0)),
                               bool(body.get("eventTime", False)),
                               int(max_runs) if max_runs is not None else None,
                               window, windows)
-            else:
-                return self._json(404, {"error": f"unknown path {self.path}"})
             job = self.manager.submit(program, q, job_id=body.get("jobID"))
             self._json(200, {"jobID": job.id, "status": job.status})
         except (KeyError, ValueError, TypeError) as e:

@@ -206,8 +206,8 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
                     lambda a: a[d_src_g], st_full)
                 # Edges contract: src/dst are GLOBAL padded indices
                 edges = Edges(src=d_src_g, dst=d_dst_l + v_off,
-                              mask=d_mask[kk],
-                              time=d_time, first_time=d_first, props=d_props)
+                              mask=d_mask[kk], time=d_time,
+                              first_time=d_first, props=d_props, step=step)
                 payload = program.message(src_state, edges)
                 agg = combine_tree(payload, d_dst_l, n_loc, program.combiner,
                                    d_mask[kk], indices_are_sorted=True)
@@ -215,8 +215,8 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
                 dst_state = jax.tree_util.tree_map(
                     lambda a: a[s_dst_g], st_full)
                 edges = Edges(src=s_src_l + v_off, dst=s_dst_g,
-                              mask=s_mask[kk],
-                              time=s_time, first_time=s_first, props=s_props)
+                              mask=s_mask[kk], time=s_time,
+                              first_time=s_first, props=s_props, step=step)
                 payload = program.message(dst_state, edges)
                 agg_in = combine_tree(payload, s_src_l, n_loc,
                                       program.combiner, s_mask[kk],

@@ -1,0 +1,252 @@
+"""History compaction — the Archivist's memory governance, log-structured.
+
+The reference's ``Archivist`` cycle (``Archivist.scala:56-159``): when heap
+crosses 70%, compress history older than a cutoff (dedup runs of equal
+state — ``Entity.compressHistory``, ``Entity.scala:64-99``) and archive
+(drop) the oldest 10% of the time span (``Entity.archive``,
+``Entity.scala:102-138``). On an append-only log both become pure
+log→log rewrites:
+
+* ``compress_events``: within each entity's pre-cutoff history, keep only the
+  FIRST event of every run of equal aliveness. ``alive_at`` is preserved
+  exactly at every T; per-entity ``latest_time`` (window membership) may move
+  earlier for views inside a compressed run — the same approximation the
+  reference makes.
+* ``archive_events``: drop all events before the cutoff, folding pre-cutoff
+  state into baseline events at each surviving entity's latest pre-cutoff
+  activity time (with its latest property values). Every view at T >= cutoff
+  is preserved exactly (aliveness, latest_time, windows, property values);
+  views before the cutoff are gone — that is the point of archiving.
+  ``first_time`` (creation time) collapses to the baseline time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import events as ev
+from ..core.events import EventLog
+from ..core.snapshot import build_view
+
+
+def compress_events(log: EventLog, cutoff: int) -> EventLog:
+    """Run-length dedup of aliveness flips strictly before `cutoff`.
+
+    Redundancy is judged against the MERGED aliveness streams exactly as the
+    snapshot fold sees them: edge adds are vertex-revival marks (so a vertex
+    delete after an incident edge add is never "redundant"), and vertex
+    deletes are edge tombstones. Only an entity's own events are droppable;
+    a droppable event must repeat its predecessor's aliveness in the merged
+    stream. Events carrying properties are kept (their values feed later
+    lookups)."""
+    from ..core.snapshot import _endpoint_tombstones
+
+    t = log.column("time")
+    k = log.column("kind")
+    s = log.column("src")
+    d = log.column("dst")
+    keep = np.ones(log.n, bool)
+    has_props = np.zeros(log.n, bool)
+    if log.props.n:
+        has_props[np.unique(log.props.column("event"))] = True
+
+    def dedup(keys, times, alive, own_row):
+        """own_row >= 0 marks droppable events (index into the log)."""
+        if len(times) == 0:
+            return
+        order = np.lexsort((~alive, times) + tuple(reversed(keys)))
+        oalive = alive[order]
+        orow = own_row[order]
+        same = np.ones(len(order) - 1, bool)
+        for kk in keys:
+            ko = kk[order]
+            same &= ko[1:] == ko[:-1]
+        ot = times[order]
+        redundant = (same & (oalive[1:] == oalive[:-1]) & (ot[1:] < cutoff)
+                     & (orow[1:] >= 0))
+        rows = orow[1:][redundant]
+        rows = rows[~has_props[rows]]
+        keep[rows] = False
+
+    is_va = k == ev.VERTEX_ADD
+    is_vd = k == ev.VERTEX_DELETE
+    is_ea = k == ev.EDGE_ADD
+    is_ed = k == ev.EDGE_DELETE
+
+    # ---- vertex merged stream ----
+    v_ids = np.concatenate([s[is_va], s[is_vd], s[is_ea], d[is_ea]])
+    v_t = np.concatenate([t[is_va], t[is_vd], t[is_ea], t[is_ea]])
+    v_alive = np.concatenate([
+        np.ones(int(is_va.sum()), bool),
+        np.zeros(int(is_vd.sum()), bool),
+        np.ones(2 * int(is_ea.sum()), bool),
+    ])
+    v_own = np.concatenate([
+        np.flatnonzero(is_va), np.flatnonzero(is_vd),
+        np.full(2 * int(is_ea.sum()), -1, np.int64),
+    ])
+    dedup((v_ids,), v_t, v_alive, v_own)
+
+    # ---- edge merged stream (own events + endpoint tombstones) ----
+    e_s = np.concatenate([s[is_ea], s[is_ed]])
+    e_d = np.concatenate([d[is_ea], d[is_ed]])
+    e_t = np.concatenate([t[is_ea], t[is_ed]])
+    e_alive = np.concatenate([
+        np.ones(int(is_ea.sum()), bool), np.zeros(int(is_ed.sum()), bool)])
+    e_own = np.concatenate([np.flatnonzero(is_ea), np.flatnonzero(is_ed)])
+    if is_vd.any() and (is_ea.any() or is_ed.any()):
+        upairs = np.unique(np.stack([e_s, e_d], axis=1), axis=0)
+        ts_s, ts_d, ts_t = _endpoint_tombstones(upairs, s[is_vd], t[is_vd])
+        e_s = np.concatenate([e_s, ts_s])
+        e_d = np.concatenate([e_d, ts_d])
+        e_t = np.concatenate([e_t, ts_t])
+        e_alive = np.concatenate([e_alive, np.zeros(len(ts_s), bool)])
+        e_own = np.concatenate([e_own, np.full(len(ts_s), -1, np.int64)])
+    dedup((e_s, e_d), e_t, e_alive, e_own)
+
+    return _rebuild(log, keep)
+
+
+def archive_events(log: EventLog, cutoff: int) -> EventLog:
+    """Drop history before `cutoff`; fold surviving state into baselines."""
+    base = build_view(log, cutoff - 1)
+    keep = log.column("time") >= cutoff
+    out = _rebuild(log, keep)
+
+    # baselines: alive vertices / edges at cutoff-1, stamped at their latest
+    # pre-cutoff activity so window semantics at T >= cutoff stay exact
+    vm = base.v_mask
+    v_rows: dict[int, int] = {}
+    if vm.any():
+        start, _ = out.append_batch(
+            base.v_latest_time[vm],
+            np.full(int(vm.sum()), ev.VERTEX_ADD, np.uint8),
+            base.vids[vm],
+            np.full(int(vm.sum()), -1, np.int64),
+        )
+        for i, vid in enumerate(base.vids[vm]):
+            v_rows[int(vid)] = start + i
+    em = base.e_mask
+    e_rows: dict[tuple[int, int], int] = {}
+    if em.any():
+        gsrc = base.vids[base.e_src[em]]
+        gdst = base.vids[base.e_dst[em]]
+        start, _ = out.append_batch(
+            base.e_latest_time[em],
+            np.full(int(em.sum()), ev.EDGE_ADD, np.uint8),
+            gsrc, gdst,
+        )
+        for i in range(len(gsrc)):
+            e_rows[(int(gsrc[i]), int(gdst[i]))] = start + i
+
+    _attach_baseline_props(log, out, cutoff, v_rows, e_rows)
+    return out
+
+
+def _rebuild(log: EventLog, keep: np.ndarray) -> EventLog:
+    """Copy surviving events + their property rows into a fresh log."""
+    out = EventLog()
+    out.append_batch(
+        log.column("time")[keep], log.column("kind")[keep],
+        log.column("src")[keep], log.column("dst")[keep])
+    new_of_old = np.full(log.n, -1, np.int64)
+    new_of_old[np.flatnonzero(keep)] = np.arange(int(keep.sum()))
+    props = log.props
+    op = out.props
+    for name in props.keys:
+        op.key_id(name)
+    op._immutable = set(props._immutable)
+    pe = props.column("event")
+    for r in np.flatnonzero(new_of_old[pe] >= 0):
+        _copy_prop_row(props, op, int(r), int(new_of_old[pe[r]]))
+    return out
+
+
+def _copy_prop_row(src_props, dst_props, row: int, target_event: int) -> None:
+    tag = int(src_props.column("tag")[row])
+    if tag == src_props.STR_TAG:
+        sref = len(dst_props._strings)
+        dst_props._strings.append(
+            src_props.string(int(src_props.column("sref")[row])))
+    else:
+        sref = -1
+    dst_props._rows.append_row(
+        event=target_event, key=int(src_props.column("key")[row]),
+        tag=tag, num=float(src_props.column("num")[row]), sref=sref)
+
+
+def _attach_baseline_props(log: EventLog, out: EventLog, cutoff: int,
+                           v_rows: dict, e_rows: dict) -> None:
+    """Carry each surviving entity's latest (earliest, if immutable) property
+    value per key from the pre-cutoff history onto its baseline event."""
+    props = log.props
+    if props.n == 0 or (not v_rows and not e_rows):
+        return
+    pe = props.column("event")
+    pk = props.column("key")
+    ev_time = log.column("time")[pe]
+    ev_kind = log.column("kind")[pe]
+    ev_src = log.column("src")[pe]
+    ev_dst = log.column("dst")[pe]
+    pre = ev_time < cutoff
+
+    # winner per (entity, key): latest row (stable by row order), or earliest
+    # for immutable keys
+    winners: dict[tuple, int] = {}
+    for r in np.flatnonzero(pre):
+        kind = ev_kind[r]
+        if kind == ev.VERTEX_ADD:
+            ent = ("v", int(ev_src[r]))
+            if ent[1] not in v_rows:
+                continue
+        elif kind == ev.EDGE_ADD:
+            ent = ("e", int(ev_src[r]), int(ev_dst[r]))
+            if (ent[1], ent[2]) not in e_rows:
+                continue
+        else:
+            continue
+        key = ent + (int(pk[r]),)
+        prev = winners.get(key)
+        if prev is None:
+            winners[key] = int(r)
+        elif props.is_immutable(int(pk[r])):
+            if (ev_time[r], r) < (ev_time[prev], prev):
+                winners[key] = int(r)
+        else:
+            if (ev_time[r], r) >= (ev_time[prev], prev):
+                winners[key] = int(r)
+
+    for key, r in winners.items():
+        if key[0] == "v":
+            target = v_rows[key[1]]
+        else:
+            target = e_rows[(key[1], key[2])]
+        _copy_prop_row(props, out.props, r, target)
+
+
+class Archivist:
+    """Memory governor: when the log exceeds a budget, archive the oldest
+    fraction of the time span (the reference's 90/10 policy,
+    ``Archivist.scala:38-39,143-159``)."""
+
+    def __init__(self, graph, max_events: int = 50_000_000,
+                 archive_fraction: float = 0.1):
+        self.graph = graph
+        self.max_events = max_events
+        self.archive_fraction = archive_fraction
+
+    def maybe_compact(self) -> bool:
+        log = self.graph.log
+        if log.n <= self.max_events:
+            return False
+        # Rewrite a frozen prefix while ingestion continues, then atomically
+        # splice the concurrent tail back in compact_to — every holder of
+        # the EventLog object (pipelines, views) sees the compacted history;
+        # nothing is stranded or lost.
+        frozen = log.freeze()
+        span = log.max_time - log.min_time
+        cutoff = log.min_time + int(span * self.archive_fraction) + 1
+        new_log = archive_events(frozen, cutoff)
+        log.compact_to(new_log, since_row=frozen.n)
+        self.graph.invalidate_cache()
+        return True

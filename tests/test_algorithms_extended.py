@@ -1,0 +1,183 @@
+"""Golden tests for the extended algorithm library: temporal taint, BFS/SSSP,
+diffusion, flow, rankings."""
+
+import numpy as np
+import pytest
+
+from raphtory_tpu import EventLog, build_view
+from raphtory_tpu.algorithms import (
+    BFS,
+    SSSP,
+    BinaryDiffusion,
+    DegreeRanking,
+    Density,
+    FlowGraph,
+    TaintTracking,
+)
+from raphtory_tpu.engine import bsp
+
+IMAX = np.iinfo(np.int64).max
+
+
+def test_taint_respects_time_ordering():
+    """The defining property: taint only flows through transactions that
+    happen AFTER the source became tainted."""
+    log = EventLog()
+    # 1 -> 2 at t=10 ; 2 -> 3 at t=5 (BEFORE 2 could be tainted) ; 2 -> 4 @ 20
+    log.add_edge(10, 1, 2)
+    log.add_edge(5, 2, 3)
+    log.add_edge(20, 2, 4)
+    view = build_view(log, 30, include_occurrences=True)
+    prog = TaintTracking(seeds=(1,), start_time=0)
+    taint, _ = bsp.run(prog, view)
+    out = prog.reduce(taint, view)
+    got = {r["id"]: r["taintedAt"] for r in out["infections"]}
+    # 3 is NOT tainted: its incoming transaction predates 2's infection
+    assert got == {1: 0, 2: 10, 4: 20}
+
+
+def test_taint_multi_hop_chain_with_later_reuse():
+    log = EventLog()
+    log.add_edge(10, 1, 2)
+    log.add_edge(15, 2, 3)
+    log.add_edge(12, 3, 4)   # too early: 3 tainted at 15
+    log.add_edge(30, 3, 4)   # second transaction later -> taints 4 at 30
+    view = build_view(log, 50, include_occurrences=True)
+    prog = TaintTracking(seeds=(1,), start_time=5)
+    taint, _ = bsp.run(prog, view)
+    got = {r["id"]: r["taintedAt"] for r in prog.reduce(taint, view)["infections"]}
+    assert got == {1: 5, 2: 10, 3: 15, 4: 30}
+
+
+def test_taint_start_time_excludes_earlier_transactions():
+    log = EventLog()
+    log.add_edge(10, 1, 2)
+    view = build_view(log, 50, include_occurrences=True)
+    prog = TaintTracking(seeds=(1,), start_time=11)  # tainted after the tx
+    taint, _ = bsp.run(prog, view)
+    got = {r["id"]: r["taintedAt"] for r in prog.reduce(taint, view)["infections"]}
+    assert got == {1: 11}
+
+
+def test_taint_exchange_stop_list():
+    log = EventLog()
+    log.add_edge(10, 1, 2)
+    log.add_edge(20, 2, 3)
+    view = build_view(log, 50, include_occurrences=True)
+    prog = TaintTracking(seeds=(1,), start_time=0, stop_list=(2,))
+    taint, _ = bsp.run(prog, view)
+    got = {r["id"]: r["taintedAt"] for r in prog.reduce(taint, view)["infections"]}
+    # 2 absorbs (gets tainted) but never re-emits -> 3 stays clean
+    assert got == {1: 0, 2: 10}
+
+
+def _np_bfs(view, seeds, directed=True):
+    from collections import deque
+
+    li = view.local_index(seeds)
+    dist = np.full(view.n_pad, np.inf)
+    dq = deque()
+    for i in li:
+        if i >= 0:
+            dist[i] = 0
+            dq.append(int(i))
+    adj = {i: [] for i in range(view.n_pad)}
+    for j in np.flatnonzero(view.e_mask):
+        adj[int(view.e_src[j])].append(int(view.e_dst[j]))
+        if not directed:
+            adj[int(view.e_dst[j])].append(int(view.e_src[j]))
+    while dq:
+        u = dq.popleft()
+        for v in adj[u]:
+            if dist[v] == np.inf:
+                dist[v] = dist[u] + 1
+                dq.append(v)
+    return dist
+
+
+@pytest.mark.parametrize("directed", [True, False])
+def test_bfs_matches_reference(directed):
+    rng = np.random.default_rng(4)
+    log = EventLog()
+    for _ in range(300):
+        a, b = (int(x) for x in rng.integers(0, 50, 2))
+        log.add_edge(int(rng.integers(0, 100)), a, b)
+    view = build_view(log, 100)
+    prog = BFS(seeds=(3, 17), directed=directed)
+    dist, _ = bsp.run(prog, view)
+    ref = _np_bfs(view, [3, 17], directed)
+    got = np.asarray(dist)
+    mask = np.asarray(view.v_mask)
+    np.testing.assert_allclose(got[mask], ref[mask])
+
+
+def test_sssp_weighted():
+    log = EventLog()
+    log.add_edge(1, 1, 2, {"w": 5.0})
+    log.add_edge(1, 1, 3, {"w": 1.0})
+    log.add_edge(1, 3, 2, {"w": 1.0})
+    view = build_view(log, 5)
+    prog = SSSP(seeds=(1,), weight_prop="w")
+    dist, _ = bsp.run(prog, view)
+    out = prog.reduce(dist, view)
+    assert out["distances"][2] == 2.0  # 1->3->2 beats direct 5.0
+    assert out["distances"][3] == 1.0
+
+
+def test_binary_diffusion_deterministic_and_spreads():
+    rng = np.random.default_rng(5)
+    log = EventLog()
+    for _ in range(400):
+        a, b = (int(x) for x in rng.integers(0, 40, 2))
+        log.add_edge(int(rng.integers(0, 100)), a, b)
+    view = build_view(log, 100)
+    prog = BinaryDiffusion(seeds=(0,), seed=7, spread_prob=0.8)
+    r1, _ = bsp.run(prog, view)
+    r2, _ = bsp.run(prog, view)
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+    out = prog.reduce(r1, view)
+    assert out["infected"] >= 1
+    assert 0 < out["fraction"] <= 1.0
+
+
+def test_flow_graph():
+    log = EventLog()
+    log.add_edge(1, 1, 2, {"flow": 10.0})
+    log.add_edge(2, 2, 3, {"flow": 4.0})
+    log.add_edge(3, 3, 1, {"flow": 1.0})
+    view = build_view(log, 5)
+    prog = FlowGraph()
+    res, steps = bsp.run(prog, view)
+    out = prog.reduce(res, view)
+    assert out["total_flow"] == 15.0
+    by_id = {r["id"]: r for r in out["top_vertices"]}
+    assert by_id[2]["influx"] == 10.0 and by_id[2]["outflux"] == 4.0
+    assert out["top_corridors"][0]["flow"] == 10.0
+
+
+def test_degree_ranking_and_density():
+    log = EventLog()
+    for d in (2, 3, 4, 5):
+        log.add_edge(1, 1, d)   # vertex 1 out-degree 4
+    view = build_view(log, 5)
+    rank, _ = bsp.run(DegreeRanking(top_k=2), view)
+    out = DegreeRanking(top_k=2).reduce(rank, view)
+    assert out["ranking"][0]["id"] == 1
+    assert out["ranking"][0]["out"] == 4
+    dres, _ = bsp.run(Density(), view)
+    dout = Density().reduce(dres, view)
+    assert dout == {"vertices": 5, "edges": 4, "density": 4 / 20}
+
+
+def test_taint_windowed():
+    log = EventLog()
+    log.add_edge(10, 1, 2)
+    log.add_edge(90, 2, 3)
+    view = build_view(log, 100, include_occurrences=True)
+    prog = TaintTracking(seeds=(1,), start_time=0)
+    taint, _ = bsp.run(prog, view, window=20)  # only occurrences >= 80
+    got = {r["id"]: r["taintedAt"]
+           for r in prog.reduce(taint, view, window=20)["infections"]}
+    # the 1->2 tx at t=10 is outside the window: 2 never tainted, 3 neither;
+    # 1 itself is outside the window too (last activity at 10)
+    assert got == {}

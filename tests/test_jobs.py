@@ -99,17 +99,20 @@ def test_live_job_kill():
 
 
 def test_failed_job_surfaces_error():
-    g = _graph()
+    """A job blocked by the watermark fence fails with StaleViewError in
+    job.error (per-phase error surfacing, like the reference's catches)."""
+    from raphtory_tpu.ingestion.watermark import WatermarkRegistry
+
+    wm = WatermarkRegistry()
+    wm.register("slow-source")  # live source that never advances
+    g = TemporalGraph(watermarks=wm)
+    g.log.add_edge(1, 1, 2)
     mgr = AnalysisManager(g)
-    # timestamp far beyond watermark with exact fence and tiny timeout
-    job = Job = mgr.submit(
-        registry.resolve("DegreeBasic"), ViewQuery(10**12))
-    job.wait_timeout = 0.0
-    assert job.wait(35)
-    # either waited out (StaleViewError -> failed)... sources are finished so
-    # fence is open; instead this runs fine. Use an unknown-analyser path for
-    # real failure below in REST test.
-    assert job.status in ("done", "failed")
+    job = mgr.submit(registry.resolve("DegreeBasic"), ViewQuery(100),
+                     wait_timeout=0.1)
+    assert job.wait(30)
+    assert job.status == "failed"
+    assert "StaleViewError" in job.error
 
 
 def _post(port, path, body):

@@ -1,0 +1,213 @@
+"""Checkpoint round-trip + compaction semantics (compress/archive)."""
+
+import numpy as np
+import pytest
+
+from raphtory_tpu import EventLog, build_view
+from raphtory_tpu.core.service import TemporalGraph
+from raphtory_tpu.persist.checkpoint import load_log, save_log
+from raphtory_tpu.persist.compaction import (
+    Archivist,
+    archive_events,
+    compress_events,
+)
+
+
+def _edges(view):
+    s = view.vids[view.e_src[view.e_mask]]
+    d = view.vids[view.e_dst[view.e_mask]]
+    return set(zip(s.tolist(), d.tolist()))
+
+
+def _verts(view):
+    return set(view.vids[view.v_mask].tolist())
+
+
+def _rich_log(seed=0, n=500, ids=40, t_max=200):
+    rng = np.random.default_rng(seed)
+    log = EventLog()
+    for i in range(n):
+        t = int(rng.integers(0, t_max))
+        a, b = (int(x) for x in rng.integers(0, ids, 2))
+        r = rng.random()
+        if r < 0.45:
+            log.add_edge(t, a, b, {"w": float(rng.random())})
+        elif r < 0.6:
+            log.add_vertex(t, a, {"score": float(i), "!tag": float(a % 3),
+                                  "label": f"v{a}"})
+        elif r < 0.8:
+            log.delete_edge(t, a, b)
+        else:
+            log.delete_vertex(t, a)
+    return log
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    log = _rich_log()
+    path = str(tmp_path / "ckpt.npz")
+    save_log(log, path)
+    log2 = load_log(path)
+    assert log2.n == log.n
+    for T in [50, 120, 199]:
+        va, vb = build_view(log, T), build_view(log2, T)
+        assert _verts(va) == _verts(vb)
+        assert _edges(va) == _edges(vb)
+        np.testing.assert_array_equal(
+            va.vertex_prop("score"), vb.vertex_prop("score"))
+        np.testing.assert_array_equal(
+            va.vertex_prop("tag"), vb.vertex_prop("tag"))
+        np.testing.assert_array_equal(va.edge_prop("w"), vb.edge_prop("w"))
+
+
+def test_temporal_graph_checkpoint_restore(tmp_path):
+    log = _rich_log(1)
+    g = TemporalGraph(log)
+    p = str(tmp_path / "g.npz")
+    g.checkpoint(p)
+    g2 = TemporalGraph.restore(p)
+    v1, v2 = g.view_at(100, exact=False), g2.view_at(100, exact=False)
+    assert _verts(v1) == _verts(v2)
+    assert _edges(v1) == _edges(v2)
+
+
+def test_compress_preserves_aliveness_everywhere():
+    log = _rich_log(2)
+    comp = compress_events(log, cutoff=150)
+    assert comp.n <= log.n
+    for T in [0, 30, 80, 149, 160, 199]:
+        va, vb = build_view(log, T), build_view(comp, T)
+        assert _verts(va) == _verts(vb), T
+        assert _edges(va) == _edges(vb), T
+
+
+def test_compress_drops_redundant_runs():
+    log = EventLog()
+    for t in (1, 2, 3, 4, 5):
+        log.add_vertex(t, 7)       # one long alive-run
+    log.delete_vertex(10, 7)
+    log.add_vertex(20, 7)
+    comp = compress_events(log, cutoff=100)
+    # alive-run collapses to its first event; delete + revive survive
+    assert comp.n == 3
+    assert _verts(build_view(comp, 5)) == {7}
+    assert _verts(build_view(comp, 10)) == set()
+    assert _verts(build_view(comp, 20)) == {7}
+
+
+def test_archive_preserves_views_at_and_after_cutoff():
+    log = _rich_log(3)
+    cutoff = 120
+    arch = archive_events(log, cutoff)
+    assert arch.n < log.n
+    assert arch.min_time >= 0
+    for T in [cutoff, 150, 199, 10**6]:
+        va, vb = build_view(log, T), build_view(arch, T)
+        assert _verts(va) == _verts(vb), T
+        assert _edges(va) == _edges(vb), T
+        # window semantics preserved: latest activity times equal
+        np.testing.assert_array_equal(
+            va.v_latest_time[va.v_mask], vb.v_latest_time[vb.v_mask])
+        np.testing.assert_array_equal(
+            np.sort(va.e_latest_time[va.e_mask]),
+            np.sort(vb.e_latest_time[vb.e_mask]))
+
+
+def test_archive_preserves_latest_properties():
+    log = EventLog()
+    log.add_vertex(1, 5, {"score": 1.0, "!origin": 7.0, "name": "a"})
+    log.add_vertex(10, 5, {"score": 2.0, "name": "b"})
+    log.add_edge(20, 5, 6, {"w": 0.25})
+    arch = archive_events(log, cutoff=50)
+    v = build_view(arch, 60)
+    li = v.local_index([5])[0]
+    assert v.vertex_prop("score")[li] == 2.0      # latest survives
+    assert v.vertex_prop("origin")[li] == 7.0     # immutable earliest survives
+    w = v.edge_prop("w")
+    assert w[v.e_mask][0] == 0.25
+
+
+def test_archive_dead_entities_disappear_and_can_revive():
+    log = EventLog()
+    log.add_edge(1, 1, 2)
+    log.delete_vertex(10, 1)
+    log.add_edge(60, 1, 3)   # post-cutoff revival
+    arch = archive_events(log, cutoff=50)
+    v = build_view(arch, 55)
+    assert _verts(v) == {2}
+    v = build_view(arch, 60)
+    assert _verts(v) == {1, 2, 3}
+    assert _edges(v) == {(1, 3)}
+
+
+def test_archivist_policy_compacts_in_place():
+    log = _rich_log(4, n=2000, t_max=1000)
+    g = TemporalGraph(log)
+    before = g.log.n
+    version_before = log.version
+    arch = Archivist(g, max_events=100, archive_fraction=0.5)
+    assert arch.maybe_compact()
+    # in-place: pipelines holding this EventLog keep working against it
+    assert g.log is log
+    assert g.log.n < before
+    assert log.version > version_before
+    # second call with a huge budget is a no-op
+    arch2 = Archivist(g, max_events=10**9)
+    assert not arch2.maybe_compact()
+
+
+def test_compact_to_preserves_concurrent_tail():
+    """In-place compaction: events appended after the freeze survive, and all
+    holders of the log object see the compacted history."""
+    log = _rich_log(5, n=300, t_max=100)
+    g = TemporalGraph(log)
+    frozen = log.freeze()
+    n0 = frozen.n
+    # "concurrent" appends after the freeze
+    log.add_edge(150, 777, 778, {"w": 0.5})
+    log.add_vertex(160, 779, {"score": 9.0})
+    new_log = archive_events(frozen, cutoff=50)
+    log.compact_to(new_log, since_row=n0)
+    # the same object now serves compacted history + tail
+    v = build_view(log, 200)
+    assert 777 in _verts(v) and 779 in _verts(v)
+    li = v.local_index([779])[0]
+    assert v.vertex_prop("score")[li] == 9.0
+    # views at T >= cutoff match the uncompacted original
+    orig = _rich_log(5, n=300, t_max=100)
+    orig.add_edge(150, 777, 778, {"w": 0.5})
+    orig.add_vertex(160, 779, {"score": 9.0})
+    for T in [50, 99, 200]:
+        va, vb = build_view(orig, T), build_view(log, T)
+        assert _verts(va) == _verts(vb), T
+        assert _edges(va) == _edges(vb), T
+
+
+def test_checkpoint_during_live_ingestion_is_consistent(tmp_path):
+    import threading
+
+    from raphtory_tpu.persist.checkpoint import load_log, save_log
+
+    log = EventLog()
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            log.add_edge(i, i % 50, (i + 1) % 50, {"w": float(i)})
+            i += 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        import time as _t
+
+        _t.sleep(0.05)
+        for round_i in range(3):
+            p = str(tmp_path / f"live{round_i}.npz")
+            save_log(log, p)
+            back = load_log(p)  # must never be torn
+            assert back.n >= 0
+            build_view(back, 10**9)
+    finally:
+        stop.set()
+        t.join(2)
