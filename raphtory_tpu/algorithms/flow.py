@@ -43,7 +43,8 @@ class FlowGraph(VertexProgram):
         np.add.at(influx, view.e_dst[emask], w[emask])
         np.add.at(outflux, view.e_src[emask], w[emask])
         net = influx - outflux
-        order = np.argsort(-np.abs(net), kind="stable")
+        score = np.where(vmask, np.abs(net), -np.inf)
+        order = np.argsort(-score, kind="stable")
         top = [
             {
                 "id": int(view.vids[i]),

@@ -197,6 +197,7 @@ class EventLog:
         self.min_time: int = np.iinfo(np.int64).max
         self.max_time: int = np.iinfo(np.int64).min
         self._version = 0  # bumped per append; snapshot cache invalidation key
+        self._frozen = False
 
     # -- single-event API (the reference's EntityStorage verbs,
     #    EntityStorage.scala:73 vertexAdd / :237 edgeAdd / :148 vertexRemoval /
@@ -285,14 +286,26 @@ class EventLog:
         with self._lock:
             n = self._rows.n
             p_n = self.props._rows.n
+            rows = self._rows
+            props = self.props
+            # bounds/version read under the same lock that appends hold, so
+            # they describe exactly the pinned n rows
+            min_t, max_t, ver = self.min_time, self.max_time, self._version
         out = EventLog.__new__(EventLog)
         out._lock = threading.Lock()
-        out._rows = _FrozenColumns(self._rows, n)
-        out.props = _FrozenProps(self.props, p_n)
-        out.min_time = self.min_time
-        out.max_time = self.max_time
-        out._version = self._version
+        out._frozen = True
+        out._rows = _FrozenColumns(rows, n)
+        out.props = _FrozenProps(props, p_n)
+        out.min_time = min_t
+        out.max_time = max_t
+        out._version = ver
         return out
+
+    def pin(self) -> "EventLog":
+        """Consistent read snapshot for view building — O(1). Views built
+        over a pin keep serving their history even if the underlying log is
+        compacted (``compact_to``) mid-job."""
+        return self if self._frozen else self.freeze()
 
     def compact_to(self, new_log: "EventLog", since_row: int) -> None:
         """Atomically replace this log's contents with `new_log` + any events
@@ -323,11 +336,19 @@ class EventLog:
                         num=float(self.props.column("num")[r]),
                         sref=sref)
                 new_log.props._immutable |= self.props._immutable
+            if n > since_row:
+                tail_t = self._rows.view("time")[since_row:n]
+                tail_min, tail_max = int(tail_t.min()), int(tail_t.max())
+            else:
+                tail_min = np.iinfo(np.int64).max
+                tail_max = np.iinfo(np.int64).min
             self._rows = new_log._rows
             self.props = new_log.props
-            self.min_time = new_log.min_time
-            self.max_time = max(new_log.max_time, self.max_time) \
-                if new_log.n else self.max_time
+            if new_log.n:
+                self.min_time = min(new_log.min_time, tail_min)
+                self.max_time = max(new_log.max_time, tail_max)
+            else:
+                self.min_time, self.max_time = tail_min, tail_max
             self._version += 1
 
     def __repr__(self) -> str:  # pragma: no cover

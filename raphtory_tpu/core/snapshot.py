@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..native import lib as _native
 from .events import EDGE_ADD, EDGE_DELETE, VERTEX_ADD, VERTEX_DELETE, EventLog
 
 INT64_MIN = np.iinfo(np.int64).min
@@ -65,6 +66,9 @@ def _fold_latest(
     if len(times) == 0:
         empty = tuple(np.empty(0, np.int64) for _ in keys)
         return empty, np.empty(0, np.int64), np.empty(0, bool), np.empty(0, np.int64)
+    folded = _native.fold_latest(keys, times, alive)
+    if folded is not None:
+        return folded
     # lexsort: primary = keys (last first), then time, then alive (dead last)
     order = np.lexsort((~alive, times) + tuple(reversed(keys)))
     sk = [k[order] for k in keys]
@@ -241,6 +245,9 @@ def _lex_lookup(sorted_keys: tuple, query_keys: tuple) -> np.ndarray:
     # two-column case: binary search on the first col, then the second within runs
     b1, b2 = sorted_keys
     q1, q2 = query_keys
+    looked = _native.lex_lookup2(b1, b2, q1, q2)
+    if looked is not None:
+        return looked
     lo = np.searchsorted(b1, q1, side="left")
     hi = np.searchsorted(b1, q1, side="right")
     out = np.full(len(q1), -1, np.int64)
@@ -268,6 +275,7 @@ def build_view(
     ``events.py`` (vertex revive-via-edge-add, vertex-delete → incident edge
     tombstones, delete-wins tie-break).
     """
+    log = log.pin()  # consistent columns; immune to concurrent compaction
     t_all = log.column("time")
     k_all = log.column("kind")
     s_all = log.column("src")

@@ -56,6 +56,57 @@ class CsvEdgeListParser(Parser):
         )]
 
 
+class IntCsvEdgeListParser(Parser):
+    """Integer-id `src,dst,time` rows → EdgeAdd, with a native bulk path:
+    ``bulk_parse`` tokenises a whole byte buffer in C++ (the data-loader hot
+    loop) and returns ready-to-append event columns."""
+
+    def __init__(self, sep: str = ",", src_col: int = 0, dst_col: int = 1,
+                 time_col: int = 2, time_scale: int = 1):
+        self.sep = sep
+        self.src_col = src_col
+        self.dst_col = dst_col
+        self.time_col = time_col
+        self.time_scale = time_scale
+
+    def __call__(self, raw: str):
+        parts = raw.split(self.sep)
+        try:
+            return [EdgeAdd(
+                time=int(parts[self.time_col]) * self.time_scale,
+                src=int(parts[self.src_col]),
+                dst=int(parts[self.dst_col]),
+            )]
+        except (ValueError, IndexError):
+            return []
+
+    def bulk_parse(self, data: bytes):
+        return _bulk_int_edges(
+            data, self.sep, self.time_col, self.src_col, self.dst_col,
+            self.time_scale)
+
+
+def _bulk_int_edges(data: bytes, sep: str, time_col: int, src_col: int,
+                    dst_col: int, time_scale: int = 1):
+    """(time, kind, src, dst) int64/uint8 columns for EdgeAdd-only int CSVs
+    via the native tokeniser; None when the native lib is unavailable."""
+    import numpy as np
+
+    from ..core import events as ev
+    from ..native import lib as _native
+
+    cols = sorted({time_col, src_col, dst_col})
+    if len(cols) != 3:
+        return None
+    arr = _native.parse_int_csv(data, sep, tuple(cols))
+    if arr is None:
+        return None
+    by_col = {c: arr[i] for i, c in enumerate(cols)}
+    t = by_col[time_col] * time_scale
+    k = np.full(len(t), ev.EDGE_ADD, np.uint8)
+    return t, k, by_col[src_col], by_col[dst_col]
+
+
 class GabParser(Parser):
     """The README demo dataset: gab.ai post CSV, user↔parent-user reply edges
     with epoch-seconds conversion (``GabUserGraphRouter.scala:239-256``:
@@ -77,6 +128,10 @@ class GabParser(Parser):
         except (ValueError, IndexError):
             return []  # malformed row — reference routers drop these too
         return [EdgeAdd(time=t, src=src, dst=dst)]
+
+    def bulk_parse(self, data: bytes):
+        return _bulk_int_edges(
+            data, self.sep, self.time_col, self.src_col, self.dst_col)
 
 
 class JsonUpdateParser(Parser):

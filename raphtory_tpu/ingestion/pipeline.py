@@ -90,6 +90,8 @@ class IngestionPipeline:
             self.watermarks.finish(source.name)
 
     def _consume_inner(self, source: Source, parser: Parser) -> None:
+        if self._consume_bulk(source, parser):
+            return
         bt, bk, bs, bd = [], [], [], []
         pending_props: list[tuple[int, dict]] = []  # (batch offset, props)
         max_t = -(2**62)
@@ -145,3 +147,23 @@ class IngestionPipeline:
         self.counts[source.name] = n
         if max_t > -(2**62):
             self.watermarks.advance(source.name, max_t - source.disorder - 1)
+
+    def _consume_bulk(self, source: Source, parser: Parser) -> bool:
+        """Native fast path: source exposes a byte buffer and the parser a
+        C++ bulk tokeniser — one append_batch for the whole stream. Only
+        taken when it preserves row-path semantics (the parser decides by
+        returning None)."""
+        read = getattr(source, "read_bytes", None)
+        bulk = getattr(parser, "bulk_parse", None)
+        if read is None or bulk is None:
+            return False
+        out = bulk(read())
+        if out is None:
+            return False
+        t, k, s, d = out
+        if len(t):
+            self.log.append_batch(t, k, s, d)
+            self.watermarks.advance(
+                source.name, int(t.max()) - source.disorder - 1)
+        self.counts[source.name] = int(len(t))
+        return True

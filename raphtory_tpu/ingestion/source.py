@@ -57,6 +57,15 @@ class FileSource(Source):
                 if line:
                     yield line
 
+    def read_bytes(self) -> bytes:
+        """Whole-file buffer for parsers with a native bulk path."""
+        with open(self.path, "rb") as f:
+            data = f.read()
+        if self.skip_header:
+            nl = data.find(b"\n")
+            data = data[nl + 1:] if nl >= 0 else b""
+        return data
+
 
 class RandomSource(Source):
     """The paper's synthetic stress workload (``RandomSpout.scala:27-59``):

@@ -1,0 +1,144 @@
+// raphtory_tpu native runtime kernels.
+//
+// The reference's performance-critical host layer is the JVM/Akka actor
+// runtime (SURVEY §2.9); here the host hot loops around the TPU compute path
+// are native C++: the snapshot-builder's event sorts (the graph-builder), the
+// sorted two-column join used by property materialisation, and the ingest
+// CSV tokeniser (the data-loader). Loaded from Python via ctypes
+// (`raphtory_tpu/native/lib.py`); every entry point has a pure-numpy
+// fallback, so this library is an accelerator, not a dependency.
+//
+// Build: g++ -O3 -shared -fPIC (see native/build.py). Plain C ABI.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Argsort event rows by (k1[, k2], time, alive-first) — the order
+// np.lexsort((~alive, times, k2, k1)) produces. At equal (key, time) dead
+// rows sort last so a "last row of group" scan picks the tombstone
+// (delete-wins tie-break of the temporal fold; Entity.scala:41-57 semantics).
+// k2 may be null for single-key streams. order_out: int64[n].
+void rtpu_sort_events(int64_t n, const int64_t* k1, const int64_t* k2,
+                      const int64_t* times, const uint8_t* alive,
+                      int64_t* order_out) {
+    for (int64_t i = 0; i < n; ++i) order_out[i] = i;
+    if (k2 != nullptr) {
+        std::sort(order_out, order_out + n, [&](int64_t a, int64_t b) {
+            if (k1[a] != k1[b]) return k1[a] < k1[b];
+            if (k2[a] != k2[b]) return k2[a] < k2[b];
+            if (times[a] != times[b]) return times[a] < times[b];
+            return alive[a] > alive[b];
+        });
+    } else {
+        std::sort(order_out, order_out + n, [&](int64_t a, int64_t b) {
+            if (k1[a] != k1[b]) return k1[a] < k1[b];
+            if (times[a] != times[b]) return times[a] < times[b];
+            return alive[a] > alive[b];
+        });
+    }
+}
+
+// Fused group fold over rows already sorted by rtpu_sort_events: one output
+// row per distinct key with (latest_time, latest_alive, first_time) — the
+// whole _fold_latest in one pass. Returns the group count.
+int64_t rtpu_fold_sorted(int64_t n, const int64_t* k1, const int64_t* k2,
+                         const int64_t* times, const uint8_t* alive,
+                         const int64_t* order,
+                         int64_t* out_k1, int64_t* out_k2,
+                         int64_t* out_latest_t, uint8_t* out_alive,
+                         int64_t* out_first_t) {
+    int64_t g = -1;
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t r = order[i];
+        bool fresh = (g < 0) || k1[r] != out_k1[g] ||
+                     (k2 != nullptr && k2[r] != out_k2[g]);
+        if (fresh) {
+            ++g;
+            out_k1[g] = k1[r];
+            if (k2 != nullptr) out_k2[g] = k2[r];
+            out_first_t[g] = times[r];
+        }
+        out_latest_t[g] = times[r];
+        out_alive[g] = alive[r];
+    }
+    return g + 1;
+}
+
+// Position of each (q1, q2) pair in key columns sorted lexicographically by
+// (b1, b2); -1 when absent. Replaces the per-query Python loop in
+// snapshot._lex_lookup (edge-property materialisation hot path).
+void rtpu_lex_lookup2(int64_t nb, const int64_t* b1, const int64_t* b2,
+                      int64_t nq, const int64_t* q1, const int64_t* q2,
+                      int64_t* out) {
+    for (int64_t i = 0; i < nq; ++i) {
+        const int64_t* lo = std::lower_bound(b1, b1 + nb, q1[i]);
+        const int64_t* hi = std::upper_bound(lo, b1 + nb, q1[i]);
+        if (lo == hi) { out[i] = -1; continue; }
+        int64_t l = lo - b1, h = hi - b1;
+        const int64_t* p = std::lower_bound(b2 + l, b2 + h, q2[i]);
+        out[i] = (p != b2 + h && *p == q2[i]) ? (p - b2) : -1;
+    }
+}
+
+// CSV integer-column tokeniser: extract up to `ncols` int64 columns (by
+// 0-based column index, ascending) from a newline-separated byte buffer.
+// Rows with missing/non-numeric cells are skipped. Returns rows written.
+// outs: ncols pointers worth of int64[max_rows] laid out contiguously as
+// out[c * max_rows + row].
+int64_t rtpu_parse_int_csv(const char* buf, int64_t len, char sep,
+                           const int64_t* cols, int64_t ncols,
+                           int64_t* out, int64_t max_rows) {
+    int64_t row = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    int64_t vals[16];
+    while (p < end && row < max_rows) {
+        const char* line_end = static_cast<const char*>(
+            memchr(p, '\n', end - p));
+        if (!line_end) line_end = end;
+        int64_t col = 0, want = 0;
+        bool ok = true;
+        const char* q = p;
+        while (want < ncols && q <= line_end) {
+            const char* cell_end = q;
+            while (cell_end < line_end && *cell_end != sep) ++cell_end;
+            if (col == cols[want]) {
+                // Parse int64 exactly like Python's int(cell): optional
+                // sign, digits only, surrounding whitespace tolerated
+                // (includes the \r of CRLF files). Anything else — floats,
+                // empty cells — rejects the row, matching the row path.
+                const char* c = q;
+                const char* ce = cell_end;
+                while (c < ce && (*c == ' ' || *c == '\t')) ++c;
+                while (ce > c && (ce[-1] == ' ' || ce[-1] == '\t' ||
+                                  ce[-1] == '\r')) --ce;
+                bool neg = false;
+                if (c < ce && (*c == '-' || *c == '+')) {
+                    neg = (*c == '-');
+                    ++c;
+                }
+                if (c == ce || *c < '0' || *c > '9') { ok = false; break; }
+                int64_t v = 0;
+                while (c < ce && *c >= '0' && *c <= '9')
+                    v = v * 10 + (*c++ - '0');
+                if (c != ce) { ok = false; break; }
+                vals[want++] = neg ? -v : v;
+            }
+            ++col;
+            if (cell_end == line_end) break;
+            q = cell_end + 1;
+        }
+        if (ok && want == ncols) {
+            for (int64_t c2 = 0; c2 < ncols; ++c2)
+                out[c2 * max_rows + row] = vals[c2];
+            ++row;
+        }
+        p = line_end + 1;
+    }
+    return row;
+}
+
+}  // extern "C"

@@ -314,6 +314,11 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
     ``engine.bsp.run`` plus the mesh. Returns (result, steps) with result
     leading axes [K windows, n_pad] in GLOBAL vertex order."""
     batched = windows is not None
+    if getattr(program, "needs_occurrences", False):
+        raise NotImplementedError(
+            "occurrence-based programs (temporal multigraph traversal, e.g. "
+            "TaintTracking) are not supported on a mesh yet — the sharded "
+            "view partitions deduplicated edges only; run via engine.bsp")
     if windows is not None and len(windows) == 0:
         raise ValueError("windows must be a non-empty list of window sizes")
     if windows is None:

@@ -27,6 +27,7 @@ import numpy as np
 from ..core import events as ev
 from ..core.events import EventLog
 from ..core.snapshot import build_view
+from ..native import lib as _native
 
 
 def compress_events(log: EventLog, cutoff: int) -> EventLog:
@@ -54,7 +55,9 @@ def compress_events(log: EventLog, cutoff: int) -> EventLog:
         """own_row >= 0 marks droppable events (index into the log)."""
         if len(times) == 0:
             return
-        order = np.lexsort((~alive, times) + tuple(reversed(keys)))
+        order = _native.sort_events(keys, times, alive)
+        if order is None:
+            order = np.lexsort((~alive, times) + tuple(reversed(keys)))
         oalive = alive[order]
         orow = own_row[order]
         same = np.ones(len(order) - 1, bool)
