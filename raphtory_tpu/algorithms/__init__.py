@@ -9,7 +9,7 @@ from .degree import DegreeBasic
 from .diffusion import BinaryDiffusion
 from .flow import FlowGraph
 from .pagerank import PageRank
-from .rankings import DegreeRanking, Density
+from .rankings import DegreeRanking, Density, StarNode
 from .taint import TaintTracking
 from .traversal import BFS, SSSP
 
@@ -18,6 +18,7 @@ __all__ = [
     "DegreeBasic",
     "DegreeRanking",
     "Density",
+    "StarNode",
     "BinaryDiffusion",
     "FlowGraph",
     "PageRank",

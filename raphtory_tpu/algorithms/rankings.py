@@ -47,6 +47,33 @@ class DegreeRanking(VertexProgram):
 
 
 @dataclass(frozen=True)
+class StarNode(VertexProgram):
+    """The vertex with maximum in-degree in the (windowed) view — parity with
+    the random example's ``StarNode`` analyser
+    (``examples/random/depricated/StarNode.scala``)."""
+
+    max_steps: int = 0
+
+    def init(self, ctx: Context):
+        return {}
+
+    def finalize(self, state, ctx: Context):
+        return {"in": ctx.in_deg}
+
+    def reduce(self, result, view, window=None):
+        ind = np.asarray(result["in"])
+        if window is None:
+            mask = np.asarray(view.v_mask)
+        else:
+            mask = view.window_masks([window])[0][0]
+        score = np.where(mask, ind, -1)
+        if not mask.any():
+            return {"star": None, "inDegree": 0}
+        i = int(np.argmax(score))
+        return {"star": int(view.vids[i]), "inDegree": int(ind[i])}
+
+
+@dataclass(frozen=True)
 class Density(VertexProgram):
     """|E| / (|V| * (|V|-1)) on the (windowed) view."""
 

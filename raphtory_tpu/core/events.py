@@ -233,8 +233,14 @@ class EventLog:
         kind: np.ndarray,
         src: np.ndarray,
         dst: np.ndarray,
+        props: list | None = None,
     ) -> tuple[int, int]:
-        """Append a batch of events; returns the [start, end) row range."""
+        """Append a batch of events; returns the [start, end) row range.
+
+        ``props`` is a list of ``(batch_offset, dict)`` property payloads,
+        appended under the SAME lock acquisition as the event rows — a
+        freeze() concurrent with ingestion must never observe events whose
+        properties are still pending (compact_to would drop them)."""
         with self._lock:
             rng = self._rows.append_batch(
                 time=np.asarray(time, np.int64),
@@ -242,6 +248,10 @@ class EventLog:
                 src=np.asarray(src, np.int64),
                 dst=np.asarray(dst, np.int64),
             )
+            if props:
+                start = rng[0]
+                for off, p in props:
+                    self.props.append(start + off, p)
             if len(time):
                 t = np.asarray(time)
                 self.min_time = min(self.min_time, int(t.min()))

@@ -171,6 +171,28 @@ class GraphView:
             lookup_keys=(gsrc, gdst), default=default,
         )
 
+    def vertex_prop_str(self, name: str, default=None) -> np.ndarray:
+        """object[n_pad]: latest (earliest for immutable keys) STRING value of
+        a property per vertex — the host-side face of the reference's
+        ``Any``-valued properties (``MutableProperty.scala:19``). Strings never
+        ship to device; reducers (e.g. GabMostUsedTopics) read them on host."""
+        return _materialise_prop(
+            self._log, self._vadd_rows, name, self.time,
+            keys=(self._log.column("src")[self._vadd_rows],),
+            lookup_keys=(self.vids,), default=default, strings=True,
+        )
+
+    def edge_prop_str(self, name: str, default=None) -> np.ndarray:
+        gsrc = self.vids[self.e_src]
+        gdst = self.vids[self.e_dst]
+        log = self._log
+        rows = self._eadd_rows
+        return _materialise_prop(
+            log, rows, name, self.time,
+            keys=(log.column("src")[rows], log.column("dst")[rows]),
+            lookup_keys=(gsrc, gdst), default=default, strings=True,
+        )
+
     def local_index(self, global_ids) -> np.ndarray:
         """Map global vertex ids → local indices (-1 if absent/padded)."""
         g = np.asarray(global_ids, np.int64)
@@ -182,22 +204,27 @@ class GraphView:
         return np.where(base[pos] == g, pos, -1).astype(np.int64)
 
 
-def _materialise_prop(log, rows, name, T, keys, lookup_keys, default):
-    """Latest (or earliest, for immutable keys) numeric property value <= T."""
+def _materialise_prop(log, rows, name, T, keys, lookup_keys, default,
+                      strings: bool = False):
+    """Latest (or earliest, for immutable keys) property value <= T.
+
+    ``strings=False`` joins the numeric column (f64 output); ``strings=True``
+    joins the string-ref column and resolves refs on host (object output)."""
     n_out = len(lookup_keys[0])
-    out = np.full(n_out, default, np.float64)
+    out = (np.full(n_out, default, object) if strings
+           else np.full(n_out, default, np.float64))
     if log is None or name not in log.props._key_ids:
         return out
     kid = log.props._key_ids[name]
     pe = log.props.column("event")
     pk = log.props.column("key")
-    pnum = log.props.column("num")
     ptag = log.props.column("tag")
-    sel = (pk == kid) & (ptag == log.props.NUM_TAG)
+    want_tag = log.props.STR_TAG if strings else log.props.NUM_TAG
+    sel = (pk == kid) & (ptag == want_tag)
     if not sel.any():
         return out
     ev = pe[sel]
-    val = pnum[sel]
+    val = log.props.column("sref")[sel] if strings else log.props.column("num")[sel]
     # join prop rows onto the event subset `rows` (sorted ascending)
     pos = np.searchsorted(rows, ev)
     pos = np.clip(pos, 0, len(rows) - 1)
@@ -228,7 +255,13 @@ def _materialise_prop(log, rows, name, T, keys, lookup_keys, default):
     # look up each output key among ukeys (sorted lexicographically)
     out_idx = _lex_lookup(ukeys, lookup_keys)
     found = out_idx >= 0
-    out[found] = uval[out_idx[found]]
+    if strings:
+        hit_refs = uval[out_idx[found]]
+        resolved = np.array([log.props.string(int(r)) for r in hit_refs],
+                            object) if len(hit_refs) else np.empty(0, object)
+        out[found] = resolved
+    else:
+        out[found] = uval[out_idx[found]]
     return out
 
 

@@ -1,0 +1,135 @@
+"""Blockchain dataset family — Ethereum / Bitcoin / Chainalysis.
+
+Parsers mirror the reference routers' graph shapes (behavior, not code):
+
+* ``EthereumTransactionParser`` — 4-column csv ``from,to,txid,timestamp`` →
+  wallet→wallet edge per transaction; empty ``to`` means burnt coins sent to
+  the "null" wallet (``EthereumTransactionRouter.scala``). Wallet addresses
+  are hashed to i64 ids (``assignID`` analogue) with the raw address kept as
+  an immutable string property.
+* ``BitcoinBlockParser`` — one JSON block per record → bipartite
+  transaction↔address graph: a vertex per txid (``type='transaction'`` plus
+  block metadata), a vertex per output address (``type='address'``), an edge
+  tx→address per vout carrying ``value``; coinbase inputs come from the
+  "coingen" vertex; non-coinbase inputs attach spent-output edges
+  address→tx (``BitcoinRouter.scala``).
+* ``ChainalysisABParser`` — csv rows ``txid,srcCluster,dstCluster,btc,usd,
+  time`` → cluster→transaction→cluster with BitCoin/USD value properties on
+  both legs (``ChainalysisABRouter.scala``).
+
+Domain analysers are the core library specialised: ``EthereumTaintTracking``
+(time-respecting taint over transaction occurrences, incl. the
+exchange-stop variant via ``stop_list``) and ``EthereumDegreeRanking``.
+
+The reference's live spouts (geth JSON-RPC poller, Kafka, Postgres) need
+network egress; their capability surface here is a ``Source`` that reads
+pre-fetched block JSON from file/iterable — the RPC pollers are thin wrappers
+a deployment adds around it.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..algorithms.rankings import DegreeRanking
+from ..algorithms.taint import TaintTracking
+from ..ingestion.parser import Parser
+from ..ingestion.updates import EdgeAdd, VertexAdd, assign_id
+
+# core algorithms under their reference example names
+EthereumTaintTracking = TaintTracking   # stop_list=() ⇒ plain TaintTracking;
+                                        # non-empty ⇒ TaintTrackExchangeStop
+EthereumDegreeRanking = DegreeRanking
+
+
+class EthereumTransactionParser(Parser):
+    """``from,to,txid,timestamp`` (seconds) — reference columns 0..3."""
+
+    def __init__(self, sep: str = ","):
+        self.sep = sep
+
+    def __call__(self, raw: str):
+        f = [c.strip().strip("()") for c in raw.split(self.sep)]
+        try:
+            t = int(f[3]) * 1000  # seconds → millis like the reference
+        except (ValueError, IndexError):
+            return []
+        src_addr = f[0]
+        dst_addr = f[1] if len(f) > 1 and f[1] else "null"
+        src = assign_id(src_addr)
+        dst = assign_id(dst_addr)
+        return [
+            VertexAdd(t, src, {"!id": src_addr}),
+            VertexAdd(t, dst, {"!id": dst_addr}),
+            EdgeAdd(t, src, dst, {"!id": f[2] if len(f) > 2 else ""}),
+        ]
+
+
+class BitcoinBlockParser(Parser):
+    """One JSON block (dict or string) → tx/address bipartite updates."""
+
+    COINGEN = assign_id("coingen")
+
+    def __call__(self, raw):
+        block = json.loads(raw) if isinstance(raw, str) else raw
+        t = int(block["time"])
+        height = int(block.get("height", -1))
+        blockhash = str(block.get("hash", ""))
+        out = []
+        for tx in block.get("tx", []):
+            txid = str(tx["txid"])
+            tx_vid = assign_id(txid)
+            total = 0.0
+            for vout in tx.get("vout", []):
+                value = float(vout.get("value", 0.0))
+                spk = vout.get("scriptPubKey", {})
+                addrs = spk.get("addresses") or ["nulldata"]
+                addr = str(addrs[0])
+                if addr == "nulldata":
+                    value = 0.0  # burnt money, like the reference
+                total += value
+                a_vid = assign_id(addr)
+                out.append(VertexAdd(t, a_vid, {"!type": "address",
+                                                "!address": addr}))
+                out.append(EdgeAdd(t, tx_vid, a_vid,
+                                   {"n": int(vout.get("n", 0)),
+                                    "value": value}))
+            out.append(VertexAdd(t, tx_vid, {
+                "!type": "transaction", "!id": txid, "total": total,
+                "block": height, "!blockhash": blockhash}))
+            for vin in tx.get("vin", []):
+                if "coinbase" in vin:
+                    out.append(VertexAdd(t, self.COINGEN,
+                                         {"!type": "coingen"}))
+                    out.append(EdgeAdd(t, self.COINGEN, tx_vid))
+                elif "txid" in vin:  # spending a previous tx's output
+                    out.append(EdgeAdd(t, assign_id(str(vin["txid"])), tx_vid,
+                                       {"vout": int(vin.get("vout", 0))}))
+        return out
+
+
+class ChainalysisABParser(Parser):
+    """``txid,srcCluster,dstCluster,btc,usd,time`` → two-leg payment path."""
+
+    def __init__(self, sep: str = ","):
+        self.sep = sep
+
+    def __call__(self, raw: str):
+        f = [c.strip() for c in raw.split(self.sep)]
+        try:
+            t = int(f[5])
+            btc = float(f[3])
+            usd = float(f[4])
+        except (ValueError, IndexError):
+            return []
+        src = assign_id("cluster:" + f[1])
+        dst = assign_id("cluster:" + f[2])
+        tx = assign_id("tx:" + f[0])
+        val = {"BitCoin": btc, "USD": usd}
+        return [
+            VertexAdd(t, src, {"!type": "Cluster"}),
+            VertexAdd(t, dst, {"!type": "Cluster"}),
+            VertexAdd(t, tx, {"!type": "Transaction"}),
+            EdgeAdd(t, src, tx, dict(val)),
+            EdgeAdd(t, tx, dst, dict(val)),
+        ]

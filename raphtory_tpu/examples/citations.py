@@ -1,0 +1,44 @@
+"""Citation-network dataset.
+
+Mirrors ``CitationRouter.scala``: csv rows
+``source,target,sourceCitedTargetOn,targetCreationDate,targetLastCitedOn``
+(dates ``dd/MM/yyyy`` → unix seconds). The source vertex appears at citation
+time, the target at its creation date, the citation edge at citation time —
+and when this citation is the target's LAST one, the edge is tombstoned at
+that same time (the reference's quirky end-of-life signal)."""
+
+from __future__ import annotations
+
+import datetime as _dt
+
+from ..ingestion.parser import Parser
+from ..ingestion.updates import EdgeAdd, EdgeDelete, VertexAdd
+
+
+def _epoch(d: str) -> int:
+    dt = _dt.datetime.strptime(d.strip(), "%d/%m/%Y")
+    return int(dt.replace(tzinfo=_dt.timezone.utc).timestamp())
+
+
+class CitationParser(Parser):
+    def __init__(self, sep: str = ","):
+        self.sep = sep
+
+    def __call__(self, raw: str):
+        f = [c.strip() for c in raw.split(self.sep)]
+        try:
+            src = int(f[0])
+            dst = int(f[1])
+            cited_on = _epoch(f[2])
+            target_created = _epoch(f[3])
+            last_cited = _epoch(f[4])
+        except (ValueError, IndexError):
+            return []
+        out = [
+            VertexAdd(cited_on, src),
+            VertexAdd(target_created, dst),
+            EdgeAdd(cited_on, src, dst),
+        ]
+        if cited_on == last_cited:
+            out.append(EdgeDelete(last_cited, src, dst))
+        return out

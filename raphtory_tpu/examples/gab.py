@@ -1,0 +1,107 @@
+"""Gab social-network dataset (the README demo workload).
+
+Parsers mirror the two reference routers over the semicolon-separated Gab
+dump: user↔user reply edges (``GabUserGraphRouter.scala:20-35`` — columns 2
+and 5, rows with non-positive parent dropped) and post→post comment edges
+(``GabPostGraphRouter`` — columns 1 and 4). ``GabMostUsedTopics`` is the
+domain analyser (``examples/gab/analysis/GabMostUsedTopics.scala``): top-k
+topic vertices by in-degree with their string id/title properties.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..algorithms.rankings import DegreeRanking
+from ..engine.program import Context
+from ..ingestion.parser import Parser
+from ..ingestion.updates import EdgeAdd, VertexAdd
+
+
+def _epoch(ts: str) -> int:
+    """'2016-08-10 13:58:06(.frac)' or ISO-T variant → unix seconds (UTC),
+    like the reference's dateToUnixTime over the first 19 chars. Already-
+    numeric timestamps (pre-converted dumps) pass through unchanged."""
+    s = ts.strip()
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    d = _dt.datetime.strptime(s[:19].replace("T", " "), "%Y-%m-%d %H:%M:%S")
+    return int(d.replace(tzinfo=_dt.timezone.utc).timestamp())
+
+
+class GabUserGraphParser(Parser):
+    """user→parent-user reply edges; drops rows whose parent id <= 0."""
+
+    def __init__(self, sep: str = ";", time_col: int = 0, src_col: int = 2,
+                 dst_col: int = 5):
+        self.sep = sep
+        self.time_col = time_col
+        self.src_col = src_col
+        self.dst_col = dst_col
+
+    def __call__(self, raw: str):
+        f = [c.strip() for c in raw.split(self.sep)]
+        try:
+            src = int(f[self.src_col])
+            dst = int(f[self.dst_col])
+            if dst <= 0:
+                return []
+            t = _epoch(f[self.time_col])
+        except (ValueError, IndexError):
+            return []
+        return [
+            VertexAdd(t, src, {"!type": "User"}),
+            VertexAdd(t, dst, {"!type": "User"}),
+            EdgeAdd(t, src, dst),
+        ]
+
+
+class GabPostGraphParser(GabUserGraphParser):
+    """post→parent-post comment edges (the commented-out 'comment wise'
+    column choice in the reference router: columns 1 and 4)."""
+
+    def __init__(self, sep: str = ";", time_col: int = 0, src_col: int = 1,
+                 dst_col: int = 4):
+        super().__init__(sep, time_col, src_col, dst_col)
+
+
+@dataclass(frozen=True)
+class GabMostUsedTopics(DegreeRanking):
+    """Top-k vertices of string-type ``topic`` by in-degree, reporting their
+    ``id``/``title`` string properties — a host reducer over one device
+    in-degree pass (the reference runs it as a 1-superstep analyser)."""
+
+    top_k: int = 10
+    by: str = "in"
+    type_prop: str = "type"
+    type_value: str = "topic"
+
+    def reduce(self, result, view, window=None):
+        ind = np.asarray(result["in"])
+        if window is None:
+            mask = np.asarray(view.v_mask)
+        else:
+            mask = view.window_masks([window])[0][0]
+        vtype = view.vertex_prop_str(self.type_prop)
+        is_topic = mask & np.array(
+            [v == self.type_value for v in vtype], bool)
+        score = np.where(is_topic, ind, -1)
+        order = np.argsort(-score, kind="stable")[: self.top_k]
+        ids = view.vertex_prop_str("id")
+        titles = view.vertex_prop_str("title")
+        return {
+            "topics": [
+                {
+                    "id": ids[i] if ids[i] is not None else str(int(view.vids[i])),
+                    "title": titles[i] if titles[i] is not None else "no title",
+                    "uses": int(ind[i]),
+                }
+                for i in order
+                if is_topic[i]
+            ]
+        }

@@ -1,0 +1,93 @@
+"""The synthetic stress workload as JSON commands on the wire.
+
+The reference's ``RandomSpout`` emits JSON command strings
+(``examples/random/actors/RandomSpout.scala:46-59``) that ``RandomRouter``
+parses back into typed updates (``RandomRouter.scala:142-213``). The
+GraphUpdate-native fast path is :class:`raphtory_tpu.ingestion.source
+.RandomSource`; this module provides the wire-format pair for parity and for
+exercising the parser stage under load (the paper's ramp protocol lives in
+``RateLimited``).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+from ..ingestion.source import Source
+from ..ingestion.parser import Parser
+from ..ingestion.updates import EdgeAdd, EdgeDelete, VertexAdd, VertexDelete
+
+_PROP_KEYS = [f"prop{i}" for i in range(20)]  # 20-key pool (paper §6.1)
+
+
+class RandomCommandSource(Source):
+    """Yields reference-shaped JSON command strings.
+
+    ``mix`` = (vertex-add, edge-add, vertex-del, edge-del) probabilities;
+    add-only default 30/70 mirrors ``RandomSpout.distribution()``; the
+    worst-case mix from the paper is (0.3, 0.4, 0.1, 0.2).
+    """
+
+    def __init__(self, n_events: int, id_pool: int = 1_000_000, seed: int = 0,
+                 mix=(0.3, 0.7, 0.0, 0.0), n_props: int = 2,
+                 name: str = "random-json"):
+        self.n_events = n_events
+        self.id_pool = id_pool
+        self.seed = seed
+        self.mix = mix
+        self.n_props = n_props
+        self.name = name
+        self.disorder = 0
+
+    def __iter__(self):
+        rng = random.Random(self.seed)
+        cum = []
+        acc = 0.0
+        for p in self.mix:
+            acc += p
+            cum.append(acc)
+        for t in range(1, self.n_events + 1):
+            r = rng.random() * cum[-1]
+            src = rng.randrange(self.id_pool)
+            if r <= cum[0]:
+                props = {k: round(rng.random(), 6)
+                         for k in rng.sample(_PROP_KEYS, self.n_props)}
+                yield json.dumps({"VertexAdd": {
+                    "messageID": t, "srcID": src, "properties": props}})
+            elif r <= cum[1]:
+                dst = rng.randrange(self.id_pool)
+                yield json.dumps({"EdgeAdd": {
+                    "messageID": t, "srcID": src, "dstID": dst}})
+            elif r <= cum[2]:
+                yield json.dumps({"VertexRemoval": {
+                    "messageID": t, "srcID": src}})
+            else:
+                dst = rng.randrange(self.id_pool)
+                yield json.dumps({"EdgeRemoval": {
+                    "messageID": t, "srcID": src, "dstID": dst}})
+
+
+class RandomJsonParser(Parser):
+    """Parses the command JSON back into typed updates (RandomRouter parity:
+    VertexAdd/EdgeAdd/VertexRemoval/EdgeRemoval keyed objects with
+    messageID/srcID/dstID/properties fields)."""
+
+    def __call__(self, raw: str):
+        obj = json.loads(raw)
+        if "VertexAdd" in obj:
+            c = obj["VertexAdd"]
+            return [VertexAdd(int(c["messageID"]), int(c["srcID"]),
+                              c.get("properties") or None)]
+        if "EdgeAdd" in obj:
+            c = obj["EdgeAdd"]
+            return [EdgeAdd(int(c["messageID"]), int(c["srcID"]),
+                            int(c["dstID"]), c.get("properties") or None)]
+        if "VertexRemoval" in obj:
+            c = obj["VertexRemoval"]
+            return [VertexDelete(int(c["messageID"]), int(c["srcID"]))]
+        if "EdgeRemoval" in obj:
+            c = obj["EdgeRemoval"]
+            return [EdgeDelete(int(c["messageID"]), int(c["srcID"]),
+                               int(c["dstID"]))]
+        return []  # unknown command: reference prints and drops

@@ -1,0 +1,63 @@
+"""Track-and-trace GPS dataset → bipartite user/location visit graph.
+
+Mirrors ``TrackAndTraceRouter.scala:10-80``: each datapoint becomes a User
+vertex, a Location vertex whose id is a grid cell (lat/lon → ellipsoidal
+cartesian → floor-quantised to ``grid_size`` metres → hashed), and a
+"user visited location" edge. The full reference record is 25 columns
+(user id col 0, lat col 4, lon col 5, epoch-seconds col 11); a compact
+``user,lat,lon,time`` layout is supported for tests via column kwargs.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..ingestion.parser import Parser
+from ..ingestion.updates import EdgeAdd, VertexAdd, assign_id
+
+EARTH_EQU = 6378137.0       # equatorial radius, m
+EARTH_POL = 6356752.3142    # polar radius, m
+
+
+def _cart(lat: float, lon: float) -> tuple[float, float]:
+    e = 1 - (EARTH_EQU ** 2) / (EARTH_POL ** 2)
+    n = EARTH_EQU / math.sqrt(1 - e * math.sin(lat) ** 2)
+    return n * math.cos(lat) * math.cos(lon), n * math.cos(lat) * math.sin(lon)
+
+
+def location_id(lat: float, lon: float, grid_size: float = 100.0) -> int:
+    """Stable id of the grid cell containing (lat, lon)."""
+    x, y = _cart(lat, lon)
+    ptx = math.floor(x / grid_size) * grid_size
+    pty = math.floor(y / grid_size) * grid_size
+    return assign_id(f"{ptx}{pty}")
+
+
+class TrackAndTraceParser(Parser):
+    def __init__(self, grid_size: float = 100.0, sep: str = ",",
+                 user_col: int = 0, lat_col: int = 4, lon_col: int = 5,
+                 time_col: int = 11, time_scale: int = 1000):
+        self.grid_size = grid_size
+        self.sep = sep
+        self.user_col = user_col
+        self.lat_col = lat_col
+        self.lon_col = lon_col
+        self.time_col = time_col
+        self.time_scale = time_scale  # seconds → millis like the reference
+
+    def __call__(self, raw: str):
+        f = [c.strip() for c in raw.split(self.sep)]
+        try:
+            user = int(f[self.user_col])
+            lat = float(f[self.lat_col])
+            lon = float(f[self.lon_col])
+            t = int(f[self.time_col]) * self.time_scale
+        except (ValueError, IndexError):
+            return []
+        loc = location_id(lat, lon, self.grid_size)
+        return [
+            VertexAdd(t, user, {"!type": "User"}),
+            VertexAdd(t, loc, {"!type": "Location",
+                               "latitude": lat, "longitude": lon}),
+            EdgeAdd(t, user, loc, {"!type": "User Visited Location"}),
+        ]

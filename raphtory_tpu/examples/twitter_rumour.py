@@ -1,0 +1,44 @@
+"""Twitter rumour-interaction dataset (PHEME-style).
+
+Mirrors ``rumourInteractRouter.scala``: each record is a rumour status tag
+plus one tweet JSON object; a tweet replying to someone becomes a reply edge
+user→replied-to-user stamped with the IMMUTABLE ``rumourStatus`` property
+(first write wins — ``ImmutableProperty.scala:9-11``); a non-reply tweet
+becomes a lone vertex with the same property. Records may be pre-joined
+strings ``"<status>__<tweet-json>"`` (the reference packs a status and a
+file path this way) or ``(status, json)`` tuples.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+
+from ..ingestion.parser import Parser
+from ..ingestion.updates import EdgeAdd, VertexAdd
+
+_TWITTER_FMT = "%a %b %d %H:%M:%S %z %Y"   # EEE MMM dd HH:mm:ss ZZZZZ yyyy
+
+
+def _twitter_epoch_ms(date: str) -> int:
+    return int(_dt.datetime.strptime(date.strip(), _TWITTER_FMT)
+               .timestamp() * 1000)
+
+
+class RumourParser(Parser):
+    def __call__(self, raw):
+        if isinstance(raw, tuple):
+            status, payload = raw
+        else:
+            status, payload = str(raw).split("__", 1)
+        tweet = json.loads(payload) if isinstance(payload, str) else payload
+        try:
+            t = _twitter_epoch_ms(tweet["created_at"])
+            src = int(tweet["user"]["id"])
+        except (KeyError, ValueError, TypeError):
+            return []
+        reply_to = tweet.get("in_reply_to_user_id")
+        props = {"!rumourStatus": str(status)}
+        if reply_to is not None:
+            return [EdgeAdd(t, src, int(reply_to), props)]
+        return [VertexAdd(t, src, props)]

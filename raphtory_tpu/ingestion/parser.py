@@ -108,30 +108,18 @@ def _bulk_int_edges(data: bytes, sep: str, time_col: int, src_col: int,
 
 
 class GabParser(Parser):
-    """The README demo dataset: gab.ai post CSV, user↔parent-user reply edges
-    with epoch-seconds conversion (``GabUserGraphRouter.scala:239-256``:
-    columns include timestamp, user id, parent user id; self-replies kept)."""
+    """Deprecated alias of :class:`raphtory_tpu.examples.gab
+    .GabUserGraphParser` — the canonical gab.ai dump parser (date-string or
+    epoch timestamps, non-positive parent rows dropped, typed User
+    vertices). Kept so older call sites keep working."""
 
-    def __init__(self, time_col: int = 0, src_col: int = 2, dst_col: int = 5,
-                 sep: str = ";"):
-        self.time_col = time_col
-        self.src_col = src_col
-        self.dst_col = dst_col
-        self.sep = sep
+    def __init__(self, *args, **kwargs):
+        from ..examples.gab import GabUserGraphParser  # lazy: avoids cycle
+
+        self._inner = GabUserGraphParser(*args, **kwargs)
 
     def __call__(self, raw: str):
-        parts = raw.split(self.sep)
-        try:
-            t = int(parts[self.time_col])
-            src = int(parts[self.src_col])
-            dst = int(parts[self.dst_col])
-        except (ValueError, IndexError):
-            return []  # malformed row — reference routers drop these too
-        return [EdgeAdd(time=t, src=src, dst=dst)]
-
-    def bulk_parse(self, data: bytes):
-        return _bulk_int_edges(
-            data, self.sep, self.time_col, self.src_col, self.dst_col)
+        return self._inner(raw)
 
 
 class JsonUpdateParser(Parser):

@@ -101,13 +101,10 @@ class IngestionPipeline:
             nonlocal bt, bk, bs, bd, pending_props
             if not bt:
                 return
-            start, _ = self.log.append_batch(
+            self.log.append_batch(
                 np.asarray(bt, np.int64), np.asarray(bk, np.uint8),
-                np.asarray(bs, np.int64), np.asarray(bd, np.int64))
-            if pending_props:
-                with self.log._lock:
-                    for off, props in pending_props:
-                        self.log.props.append(start + off, props)
+                np.asarray(bs, np.int64), np.asarray(bd, np.int64),
+                props=pending_props)
             bt, bk, bs, bd, pending_props = [], [], [], [], []
 
         for raw in source:

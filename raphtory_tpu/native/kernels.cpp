@@ -122,8 +122,20 @@ int64_t rtpu_parse_int_csv(const char* buf, int64_t len, char sep,
                 }
                 if (c == ce || *c < '0' || *c > '9') { ok = false; break; }
                 int64_t v = 0;
-                while (c < ce && *c >= '0' && *c <= '9')
-                    v = v * 10 + (*c++ - '0');
+                // digits with Python-style single '_' grouping: an
+                // underscore is legal only BETWEEN two digits (int("1_0")
+                // == 10; "_1", "1_", "1__0" all reject) — keeps the bulk
+                // path row-for-row identical to the int() row path.
+                while (c < ce) {
+                    if (*c >= '0' && *c <= '9') {
+                        v = v * 10 + (*c++ - '0');
+                    } else if (*c == '_' && c + 1 < ce &&
+                               c[1] >= '0' && c[1] <= '9') {
+                        ++c;
+                    } else {
+                        break;
+                    }
+                }
                 if (c != ce) { ok = false; break; }
                 vals[want++] = neg ? -v : v;
             }

@@ -139,10 +139,13 @@ def parse_int_csv(data: bytes, sep: str, cols: tuple) -> np.ndarray | None:
     lib = _load()
     if lib is None or len(cols) > 16:
         return None
+    sep_b = sep.encode()
+    if len(sep_b) != 1:  # multi-byte separator: only the row path handles it
+        return None
     max_rows = data.count(b"\n") + 1
     cols_a = _c64(np.asarray(cols, np.int64))
     out = np.empty((len(cols), max_rows), np.int64)
     rows = lib.rtpu_parse_int_csv(
-        data, len(data), ctypes.c_char(sep.encode()), _p64(cols_a),
+        data, len(data), ctypes.c_char(sep_b), _p64(cols_a),
         len(cols), _p64(out), max_rows)
     return np.ascontiguousarray(out[:, :rows])

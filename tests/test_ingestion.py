@@ -36,10 +36,14 @@ def test_csv_parser_pipeline(tmp_path):
 
 
 def test_gab_parser():
+    # deprecated alias of examples.gab.GabUserGraphParser: typed endpoint
+    # vertices + the reply edge; raw epoch timestamps pass through
     par = GabParser()
     rows = par("1470000000;x;101;y;z;202")
-    assert rows == [EdgeAdd(time=1470000000, src=101, dst=202)]
+    assert rows[-1] == EdgeAdd(time=1470000000, src=101, dst=202)
+    assert len(rows) == 3
     assert par("garbage;;row") == []
+    assert par("1470000000;x;101;y;z;-7") == []  # non-positive parent drop
 
 
 def test_json_parser():

@@ -148,3 +148,41 @@ def test_bulk_csv_pipeline_matches_row_path(tmp_path):
     for col in ("time", "kind", "src", "dst"):
         np.testing.assert_array_equal(a.log.column(col), b.log.column(col))
     assert a.watermarks.safe_time() == b.watermarks.safe_time()
+
+
+def test_parse_int_csv_underscore_grouping_matches_python_int():
+    # int("1_0") == 10; "_1", "1_", "1__0" all raise — bulk path must agree
+    data = b"1_0,2,3\n_1,2,3\n1_,2,3\n1__0,2,3\n5,6,7"
+    arr = native.parse_int_csv(data, ",", (0, 1, 2))
+    np.testing.assert_array_equal(arr, [[10, 5], [2, 6], [3, 7]])
+
+
+def test_multibyte_separator_falls_back_to_row_path(tmp_path):
+    from raphtory_tpu.ingestion.parser import IntCsvEdgeListParser
+    from raphtory_tpu.ingestion.pipeline import IngestionPipeline
+    from raphtory_tpu.ingestion.source import FileSource
+
+    assert native.parse_int_csv(b"1||2||3", "||", (0, 1, 2)) is None
+    path = tmp_path / "pipes.csv"
+    path.write_text("1||2||3\n4||5||6\n")
+    pipe = IngestionPipeline()
+    pipe.add_source(FileSource(str(path), name="p"),
+                    IntCsvEdgeListParser(sep="||", src_col=0, dst_col=1,
+                                         time_col=2))
+    pipe.run()
+    assert not pipe.errors
+    assert pipe.counts["p"] == 2
+
+
+def test_append_batch_props_atomic():
+    log = EventLog()
+    log.append_batch(
+        np.array([1, 2], np.int64),
+        np.array([0, 2], np.uint8),   # VERTEX_ADD, EDGE_ADD kinds
+        np.array([10, 10], np.int64),
+        np.array([-1, 20], np.int64),
+        props=[(0, {"w": 1.5}), (1, {"x": 2.5})],
+    )
+    assert log.props.n == 2
+    # props reference the right event rows
+    np.testing.assert_array_equal(log.props.column("event"), [0, 1])
