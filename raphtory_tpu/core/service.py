@@ -70,8 +70,14 @@ class TemporalGraph:
             if hit is not None:
                 self._cache.move_to_end(key)
                 return hit
+        from ..obs.metrics import METRICS
+
+        t0 = _time.perf_counter()
         view = build_view(self.log, int(time),
                           include_occurrences=include_occurrences)
+        METRICS.snapshot_build_seconds.observe(_time.perf_counter() - t0)
+        METRICS.view_vertices.set(view.n_active)
+        METRICS.view_edges.set(view.m_active)
         with self._cache_lock:
             self._cache[key] = view
             while len(self._cache) > self._cache_size:

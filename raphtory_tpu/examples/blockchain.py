@@ -71,6 +71,12 @@ class BitcoinBlockParser(Parser):
     COINGEN = assign_id("coingen")
 
     def __call__(self, raw):
+        try:
+            return self._parse(raw)
+        except (KeyError, ValueError, TypeError):
+            return []  # malformed block: dropped, never fatal to the source
+
+    def _parse(self, raw):
         block = json.loads(raw) if isinstance(raw, str) else raw
         t = int(block["time"])
         height = int(block.get("height", -1))

@@ -74,20 +74,28 @@ class RandomJsonParser(Parser):
     messageID/srcID/dstID/properties fields)."""
 
     def __call__(self, raw: str):
-        obj = json.loads(raw)
-        if "VertexAdd" in obj:
-            c = obj["VertexAdd"]
-            return [VertexAdd(int(c["messageID"]), int(c["srcID"]),
-                              c.get("properties") or None)]
-        if "EdgeAdd" in obj:
-            c = obj["EdgeAdd"]
-            return [EdgeAdd(int(c["messageID"]), int(c["srcID"]),
-                            int(c["dstID"]), c.get("properties") or None)]
-        if "VertexRemoval" in obj:
-            c = obj["VertexRemoval"]
-            return [VertexDelete(int(c["messageID"]), int(c["srcID"]))]
-        if "EdgeRemoval" in obj:
-            c = obj["EdgeRemoval"]
-            return [EdgeDelete(int(c["messageID"]), int(c["srcID"]),
-                               int(c["dstID"]))]
-        return []  # unknown command: reference prints and drops
+        try:
+            obj = json.loads(raw)
+        except ValueError:
+            return []  # reference prints unparseable commands and moves on
+        if not isinstance(obj, dict):
+            return []
+        try:
+            if "VertexAdd" in obj:
+                c = obj["VertexAdd"]
+                return [VertexAdd(int(c["messageID"]), int(c["srcID"]),
+                                  c.get("properties") or None)]
+            if "EdgeAdd" in obj:
+                c = obj["EdgeAdd"]
+                return [EdgeAdd(int(c["messageID"]), int(c["srcID"]),
+                                int(c["dstID"]), c.get("properties") or None)]
+            if "VertexRemoval" in obj:
+                c = obj["VertexRemoval"]
+                return [VertexDelete(int(c["messageID"]), int(c["srcID"]))]
+            if "EdgeRemoval" in obj:
+                c = obj["EdgeRemoval"]
+                return [EdgeDelete(int(c["messageID"]), int(c["srcID"]),
+                                   int(c["dstID"]))]
+        except (KeyError, ValueError, TypeError):
+            pass
+        return []  # unknown/malformed command: reference prints and drops

@@ -27,18 +27,20 @@ def _twitter_epoch_ms(date: str) -> int:
 
 class RumourParser(Parser):
     def __call__(self, raw):
-        if isinstance(raw, tuple):
-            status, payload = raw
-        else:
-            status, payload = str(raw).split("__", 1)
-        tweet = json.loads(payload) if isinstance(payload, str) else payload
+        # any malformed record is dropped, never fatal — one bad line must
+        # not kill the source (the reference prints and moves on)
         try:
+            if isinstance(raw, tuple):
+                status, payload = raw
+            else:
+                status, payload = str(raw).split("__", 1)
+            tweet = json.loads(payload) if isinstance(payload, str) else payload
             t = _twitter_epoch_ms(tweet["created_at"])
             src = int(tweet["user"]["id"])
+            reply_to = tweet.get("in_reply_to_user_id")
+            props = {"!rumourStatus": str(status)}
+            if reply_to is not None:
+                return [EdgeAdd(t, src, int(reply_to), props)]
+            return [VertexAdd(t, src, props)]
         except (KeyError, ValueError, TypeError):
             return []
-        reply_to = tweet.get("in_reply_to_user_id")
-        props = {"!rumourStatus": str(status)}
-        if reply_to is not None:
-            return [EdgeAdd(t, src, int(reply_to), props)]
-        return [VertexAdd(t, src, props)]

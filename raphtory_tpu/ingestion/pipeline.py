@@ -17,6 +17,7 @@ import numpy as np
 
 from ..core import events as ev
 from ..core.events import EventLog
+from ..obs.metrics import METRICS
 from .parser import IdentityParser, Parser
 from .source import Source
 from .updates import EdgeAdd, EdgeDelete, VertexAdd, VertexDelete, assign_id
@@ -83,6 +84,7 @@ class IngestionPipeline:
 
             self.errors[source.name] = (
                 f"{type(e).__name__}: {e}\n{traceback.format_exc()}")
+            METRICS.parse_errors.labels(source.name).inc()
         finally:
             # A dead source will never append again — releasing the fence is
             # correct AND required, or one bad line would wedge safe_time()
@@ -101,10 +103,12 @@ class IngestionPipeline:
             nonlocal bt, bk, bs, bd, pending_props
             if not bt:
                 return
+            METRICS.events_ingested.labels(source.name).inc(len(bt))
             self.log.append_batch(
                 np.asarray(bt, np.int64), np.asarray(bk, np.uint8),
                 np.asarray(bs, np.int64), np.asarray(bd, np.int64),
                 props=pending_props)
+            METRICS.log_events.set(self.log.n)
             bt, bk, bs, bd, pending_props = [], [], [], [], []
 
         for raw in source:
@@ -162,5 +166,7 @@ class IngestionPipeline:
             self.log.append_batch(t, k, s, d)
             self.watermarks.advance(
                 source.name, int(t.max()) - source.disorder - 1)
+            METRICS.events_ingested.labels(source.name).inc(int(len(t)))
+            METRICS.log_events.set(self.log.n)
         self.counts[source.name] = int(len(t))
         return True

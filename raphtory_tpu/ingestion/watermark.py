@@ -34,11 +34,23 @@ class WatermarkRegistry:
             cur = self._marks.get(source, _NEG_INF)
             if watermark > cur:
                 self._marks[source] = watermark
+            self._gauge_locked()
 
     def finish(self, source: str) -> None:
         """Source exhausted: it can never hold the fence back again."""
         with self._lock:
             self._done.add(source)
+            self._gauge_locked()
+
+    def _gauge_locked(self) -> None:
+        # compute-and-set under _lock: a preempted thread must not clobber a
+        # newer safe_time with a stale lower one
+        from ..obs.metrics import METRICS
+
+        live = [w for s, w in self._marks.items() if s not in self._done]
+        t = min(live) if live else 2**62
+        if abs(t) < 2**62:  # only meaningful mid-stream values
+            METRICS.watermark.set(t)
 
     def safe_time(self) -> int:
         """Largest T such that every live source has promised no more events

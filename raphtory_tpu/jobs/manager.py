@@ -90,6 +90,9 @@ class Job:
     # ---- execution ----
 
     def _run(self) -> None:
+        from ..obs.metrics import METRICS
+
+        METRICS.jobs_started.labels(type(self.query).__name__).inc()
         try:
             q = self.query
             if isinstance(q, ViewQuery):
@@ -106,6 +109,7 @@ class Job:
             self.status = "failed"  # reference's per-phase catches
             self.error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
         finally:
+            METRICS.jobs_completed.labels(self.status).inc()
             self._done.set()
 
     def _run_live(self, q: LiveQuery) -> None:
@@ -174,7 +178,14 @@ class Job:
         return bsp.run(self.program, view, window=window, windows=windows)
 
     def _emit(self, t, window, result, view, steps, t0) -> None:
+        from ..obs.metrics import METRICS
+
         reduced = self.program.reduce(result, view, window=window)
+        # counted only after the host reduce: viewTime is END-TO-END (device
+        # compute + reduce), and a failed reduce is not a computed view
+        METRICS.views_computed.inc()
+        METRICS.view_seconds.observe(_time.perf_counter() - t0)
+        METRICS.supersteps.inc(max(int(steps), 0))
         row = {
             "time": int(t),
             "windowsize": int(window) if window is not None else None,

@@ -1,0 +1,12 @@
+"""Observability — metrics + profiling (reference L8, SURVEY §5.1/§5.5).
+
+The reference wires Kamon counters/gauges into every actor and serves
+Prometheus on :11600 (``application.conf:208-213``); here the same signal
+set is prometheus_client metrics updated by the pipeline/job/compaction
+layers, plus a JAX profiler hook for device traces (the capability Kamon's
+AspectJ weaver has no analogue for)."""
+
+from .metrics import METRICS, MetricsServer, Metrics
+from .profile import device_trace, annotate
+
+__all__ = ["METRICS", "Metrics", "MetricsServer", "device_trace", "annotate"]

@@ -1,0 +1,124 @@
+"""Prometheus metrics registry + scrape server.
+
+Signal parity with the reference's Kamon wiring (SURVEY §5.1): spout
+send-rate (``SpoutTrait.scala:136-141``), router throughput
+(``RouterManager.scala:118-122``), storage sizes and update rates
+(``WriterLogger.scala:21-30,62-84``), archivist cycle timings + heap gauge
+(``Archivist.scala:86-97,132``), plus the BSP/job signals the reference
+exposes only as log lines (viewTime per job). Scrape endpoint defaults to
+the reference's :11600.
+
+All metrics live in one module-level ``Metrics`` bundle on a dedicated
+``CollectorRegistry`` so repeated imports in tests never hit prometheus's
+duplicate-timeseries guard.
+"""
+
+from __future__ import annotations
+
+import resource
+import sys
+import threading
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    start_http_server,
+)
+
+DEFAULT_PORT = 11600  # reference's embedded Prometheus scrape port
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.registry = CollectorRegistry()
+        r = self.registry
+        # ingestion (spout/router/writer signals)
+        self.events_ingested = Counter(
+            "raphtory_events_ingested_total",
+            "Graph update events appended to the log", ["source"], registry=r)
+        self.parse_errors = Counter(
+            "raphtory_parse_errors_total",
+            "Records a parser failed on", ["source"], registry=r)
+        self.watermark = Gauge(
+            "raphtory_watermark_safe_time",
+            "Safe event time promised by all live sources", registry=r)
+        # storage (WriterLogger gauges)
+        self.log_events = Gauge(
+            "raphtory_log_events", "Rows in the event log", registry=r)
+        self.view_vertices = Gauge(
+            "raphtory_view_vertices",
+            "Vertices alive in the most recent view", registry=r)
+        self.view_edges = Gauge(
+            "raphtory_view_edges",
+            "Edges alive in the most recent view", registry=r)
+        self.snapshot_build_seconds = Histogram(
+            "raphtory_snapshot_build_seconds",
+            "Event log → device-ready view fold time", registry=r)
+        # analysis (AnalysisTask/ReaderWorker signals)
+        self.jobs_started = Counter(
+            "raphtory_jobs_started_total", "Analysis jobs submitted",
+            ["kind"], registry=r)
+        self.jobs_completed = Counter(
+            "raphtory_jobs_completed_total", "Analysis jobs finished",
+            ["status"], registry=r)
+        self.views_computed = Counter(
+            "raphtory_views_computed_total",
+            "Windowed views evaluated by the BSP engine", registry=r)
+        self.view_seconds = Histogram(
+            "raphtory_view_seconds",
+            "Per-view end-to-end time (the reference's viewTime)",
+            registry=r)
+        self.supersteps = Counter(
+            "raphtory_supersteps_total",
+            "BSP supersteps executed on device", registry=r)
+        # memory governor (Archivist signals)
+        self.compactions = Counter(
+            "raphtory_compactions_total",
+            "History compaction cycles", ["kind"], registry=r)
+        self.compaction_seconds = Histogram(
+            "raphtory_compaction_seconds",
+            "Compression/archive cycle time", registry=r)
+        self.heap_bytes = Gauge(
+            "raphtory_host_rss_bytes",
+            "Host resident set size (the reference's heap gauge)",
+            registry=r)
+        self.heap_bytes.set_function(_rss_bytes)
+
+
+def _rss_bytes() -> float:
+    """Current RSS (so compaction wins are visible), not the lifetime peak."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return float(pages * resource.getpagesize())
+    except (OSError, ValueError, IndexError):
+        # fallback: peak RSS; ru_maxrss is KiB on Linux, bytes on macOS
+        peak = float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+        return peak if sys.platform == "darwin" else peak * 1024.0
+
+
+METRICS = Metrics()
+
+
+class MetricsServer:
+    """Embedded scrape endpoint (reference: Kamon Prometheus on :11600)."""
+
+    def __init__(self, port: int = DEFAULT_PORT, addr: str = "0.0.0.0",
+                 metrics: Metrics = METRICS):
+        self.port = port
+        self.addr = addr
+        self.metrics = metrics
+        self._server = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "MetricsServer":
+        self._server, self._thread = start_http_server(
+            self.port, self.addr, registry=self.metrics.registry)
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None

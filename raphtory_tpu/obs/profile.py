@@ -1,0 +1,27 @@
+"""Device profiling hooks — the TPU-native upgrade over Kamon tracing.
+
+The reference has no distributed tracing (SURVEY §5.1 "No spans"); on TPU
+the equivalent signal is an XLA profiler trace viewable in TensorBoard /
+xprof: per-op device timelines, HBM usage, and fusion boundaries.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Capture a JAX/XLA profiler trace for the enclosed block."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span visible in the device trace (TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)

@@ -246,10 +246,17 @@ class Archivist:
         # splice the concurrent tail back in compact_to — every holder of
         # the EventLog object (pipelines, views) sees the compacted history;
         # nothing is stranded or lost.
+        from ..obs.metrics import METRICS
+
+        import time as _time
+
+        t0 = _time.perf_counter()
         frozen = log.freeze()
         span = log.max_time - log.min_time
         cutoff = log.min_time + int(span * self.archive_fraction) + 1
         new_log = archive_events(frozen, cutoff)
         log.compact_to(new_log, since_row=frozen.n)
         self.graph.invalidate_cache()
+        METRICS.compactions.labels("archive").inc()
+        METRICS.compaction_seconds.observe(_time.perf_counter() - t0)
         return True

@@ -235,3 +235,18 @@ def test_ldbc_empty_deletion_column_still_adds():
     # with the flag on and an unparsable deletion date, the add still lands
     (v2,) = LDBCParser(vertex_deletion=True)(row)
     assert isinstance(v2, VertexAdd)
+
+
+def test_malformed_records_never_kill_the_source():
+    # one bad record kills a source thread if the parser raises — every
+    # domain parser must drop, not raise
+    bad = ["no-separator-here", "{not json", '{"weird": []}',
+           '{"VertexAdd": {"messageID": "NaN"}}', ""]
+    for parser in (RumourParser(), RandomJsonParser(), BitcoinBlockParser(),
+                   EthereumTransactionParser(), LDBCParser(),
+                   CitationParser(), TrackAndTraceParser(),
+                   GabUserGraphParser(), ChainalysisABParser()):
+        for rec in bad:
+            assert parser(rec) == [], (parser, rec)
+    assert RumourParser()(("tag", "{broken")) == []
+    assert BitcoinBlockParser()({"time": "x"}) == []
