@@ -1,0 +1,16 @@
+"""Cluster management — control plane (reference L6, SURVEY §2.6, §5.3).
+
+The reference's control plane is actor-based: a ``WatchDog`` singleton
+assigns dense ids, tallies keep-alives and gates the cluster-up transition;
+``SeedActor``/``DocSvr`` handle membership; ``RaphtoryReplicator`` builds
+each node's component stack. Here the data plane is XLA collectives inside
+one SPMD program, so the control plane shrinks to: process bootstrap
+(:mod:`.bootstrap` over the JAX distributed runtime), component liveness +
+cluster-up gating (:mod:`.watchdog`), and node assembly (:mod:`.runtime`).
+"""
+
+from .bootstrap import bootstrap, topology
+from .runtime import NodeRuntime
+from .watchdog import WatchDog
+
+__all__ = ["WatchDog", "NodeRuntime", "bootstrap", "topology"]

@@ -1,0 +1,69 @@
+"""Process bootstrap + device topology discovery.
+
+The reference boots an Akka ActorSystem per process with seed-node or
+kubernetes discovery (``DocSvr.scala:39-58``) and Netty TCP remoting. The
+TPU-native equivalent is the JAX distributed runtime: one call per host
+wires the control plane (gRPC) and makes every chip in the slice/pod
+visible as a global device — all data-plane traffic then rides ICI/DCN
+inside compiled programs, not a message broker.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+
+
+def bootstrap(coordinator_address: str | None = None,
+              num_processes: int | None = None,
+              process_id: int | None = None) -> bool:
+    """Initialise the multi-host JAX runtime (idempotent).
+
+    No arguments → values come from the environment the way cloud TPU
+    runtimes inject them (the reference reads HOST_IP/seed lists the same
+    way, ``ConfigUtils.scala:19-34``). Single-process deployments (the
+    reference's ``SingleNodeSetup``) skip initialisation entirely: returns
+    False when there is nothing to join.
+    """
+    if num_processes is None and coordinator_address is None and \
+            "JAX_COORDINATOR_ADDRESS" not in os.environ and \
+            "COORDINATOR_ADDRESS" not in os.environ:
+        return False  # single-process mode
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+        return True
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            return True
+        raise
+
+
+@dataclass(frozen=True)
+class Topology:
+    """What the mesh builder needs to know about this deployment."""
+
+    n_devices: int
+    n_local_devices: int
+    n_processes: int
+    process_id: int
+    platform: str
+
+    @property
+    def multi_host(self) -> bool:
+        return self.n_processes > 1
+
+
+def topology() -> Topology:
+    devs = jax.devices()
+    return Topology(
+        n_devices=len(devs),
+        n_local_devices=len(jax.local_devices()),
+        n_processes=jax.process_count(),
+        process_id=jax.process_index(),
+        platform=devs[0].platform if devs else "none",
+    )

@@ -1,0 +1,90 @@
+"""Node assembly — the ``RaphtoryReplicator`` / ``SingleNodeSetup`` analogue.
+
+The reference's per-node factory builds the role's component stack once the
+WatchDog grants an id (``RaphtoryReplicator.scala:124-168``), and
+``SingleNodeSetup`` co-locates every role in one process for the
+single-node deployment (``singlenode/SingleNodeSetup.scala:32-40``).
+``NodeRuntime`` is both: it assembles ingestion + storage + analysis + REST
++ metrics + the archivist cycle behind one object with lifecycle methods,
+wiring heartbeats and the memory governor through the shared scheduler.
+"""
+
+from __future__ import annotations
+
+from ..core.service import TemporalGraph
+from ..ingestion.pipeline import IngestionPipeline
+from ..jobs.manager import AnalysisManager
+from ..persist.compaction import Archivist
+from ..utils.config import Settings
+from ..utils.scheduler import Scheduler
+from .bootstrap import bootstrap, topology
+from .watchdog import WatchDog
+
+
+class NodeRuntime:
+    def __init__(self, settings: Settings | None = None, mesh=None,
+                 watchdog: WatchDog | None = None):
+        self.settings = settings or Settings()
+        self.watchdog = watchdog or WatchDog(self.settings)
+        self.scheduler = Scheduler()
+        self.multi_host = bootstrap() if not self.settings.local else False
+        self.topology = topology()
+        self.graph = TemporalGraph()
+        self.pipeline = IngestionPipeline(log=self.graph.log,
+                                          watermarks=self.graph.watermarks)
+        self.mesh = mesh
+        self.manager = AnalysisManager(self.graph, mesh=mesh)
+        self.archivist = Archivist(
+            self.graph, max_events=self.settings.max_events,
+            archive_fraction=self.settings.archive_fraction)
+        self._rest = None
+        self._metrics = None
+        self._members: list[tuple[str, int]] = []  # (role, id) this node owns
+
+    # ---- lifecycle ----
+
+    def start(self, rest: bool = False, metrics: bool = False) -> "NodeRuntime":
+        s = self.settings
+        self._members.append(("shard", self.watchdog.join("shard")))
+        self._members.append(("job-server", self.watchdog.join("job-server")))
+        self.scheduler.recurring(
+            "keep-alive", s.heartbeat_interval_s, self._beat_all)
+        if s.archiving:
+            self.scheduler.recurring(
+                "archivist", s.archivist_interval_s,
+                self.archivist.maybe_compact)
+        if rest:
+            from ..jobs.rest import RestServer
+
+            self._rest = RestServer(self.manager, port=s.rest_port).start()
+        if metrics:
+            from ..obs.metrics import MetricsServer
+
+            self._metrics = MetricsServer(port=s.metrics_port).start()
+        return self
+
+    def _beat_all(self) -> None:
+        for role, cid in self._members:
+            self.watchdog.beat(role, cid)
+
+    def add_source(self, source, parser=None) -> None:
+        """Register + start consuming a source (a Spout joining the
+        cluster: id assignment then the stateCheck gate)."""
+        self._members.append(("source", self.watchdog.join("source")))
+        self.pipeline.add_source(source, parser)
+
+    def ingest(self, wait: bool = True) -> None:
+        self.pipeline.start()
+        if wait:
+            self.pipeline.join()
+
+    def submit(self, program, query):
+        return self.manager.submit(program, query)
+
+    def stop(self) -> None:
+        self.pipeline.stop()
+        self.scheduler.shutdown()
+        if self._rest is not None:
+            self._rest.stop()
+        if self._metrics is not None:
+            self._metrics.stop()

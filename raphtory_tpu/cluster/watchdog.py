@@ -1,0 +1,119 @@
+"""WatchDog — liveness registry, dense id assignment, cluster-up gate.
+
+Parity with ``WatchDog.scala``: joining components request an id and get a
+dense one back (``RequestPartitionId`` → ``AssignedId``, lines 116-131);
+keep-alives land in per-role maps (104-153); ``cluster_up`` flips once
+enough of every role is present (66-83); stale members are flagged after
+``stale_after_s`` (26-31 staleness logging) and auto-downed after
+``auto_down_after_s`` (the Akka ``auto-down-unreachable-after`` analogue,
+application.conf:152). Elastic growth parity: ids only grow, and observers
+can subscribe to component-count changes (``PartitionsCount`` republish).
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+
+from ..utils.config import Settings
+
+
+class WatchDog:
+    ROLES = ("shard", "source", "job-server")
+
+    def __init__(self, settings: Settings | None = None, clock=_time.monotonic):
+        self.settings = settings or Settings()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._next_id: dict[str, int] = {r: 0 for r in self.ROLES}
+        self._beats: dict[tuple[str, int], float] = {}
+        self._down: set[tuple[str, int]] = set()
+        self._watchers: list = []
+
+    # ---- id assignment (RequestPartitionId → AssignedId) ----
+
+    def join(self, role: str) -> int:
+        """Register a component; returns its dense id. Counts only grow —
+        the reference's elasticity contract (WatchDog.scala:116-124)."""
+        if role not in self._next_id:
+            raise ValueError(f"unknown role {role!r}; roles={self.ROLES}")
+        with self._lock:
+            cid = self._next_id[role]
+            self._next_id[role] += 1
+            self._beats[(role, cid)] = self._clock()
+            watchers = list(self._watchers)
+            count = self._next_id[role]
+        for w in watchers:  # PartitionsCount republish analogue
+            w(role, count)
+        return cid
+
+    def watch_counts(self, fn) -> None:
+        """Subscribe to (role, new_count) growth events (UpdatedCounter)."""
+        with self._lock:
+            self._watchers.append(fn)
+
+    # ---- keep-alives ----
+
+    def beat(self, role: str, cid: int) -> bool:
+        """Refresh a member's keep-alive. Beats from ids that never
+        ``join``ed are rejected (returns False) — an unknown sender must
+        not conjure a live member into the quorum counts."""
+        with self._lock:
+            key = (role, cid)
+            if key not in self._beats:
+                return False
+            if key in self._down:   # a member that beats again rejoins
+                self._down.discard(key)
+            self._beats[key] = self._clock()
+            return True
+
+    def members(self, role: str | None = None) -> list[tuple[str, int]]:
+        with self._lock:
+            return sorted(k for k in self._beats
+                          if k not in self._down
+                          and (role is None or k[0] == role))
+
+    # ---- health ----
+
+    def stale(self) -> list[tuple[str, int, float]]:
+        """(role, id, seconds-silent) for members past the staleness bar."""
+        now = self._clock()
+        bar = self.settings.stale_after_s
+        with self._lock:
+            return sorted(
+                (r, c, now - t) for (r, c), t in self._beats.items()
+                if (r, c) not in self._down and now - t > bar)
+
+    def auto_down(self) -> list[tuple[str, int]]:
+        """Mark members silent past ``auto_down_after_s`` as down; returns
+        the newly downed set. Down members drop out of cluster_up counts
+        until they beat again."""
+        now = self._clock()
+        bar = self.settings.auto_down_after_s
+        newly = []
+        with self._lock:
+            for key, t in self._beats.items():
+                if key not in self._down and now - t > bar:
+                    self._down.add(key)
+                    newly.append(key)
+        return sorted(newly)
+
+    # ---- cluster-up gate (WatchDog.scala:66-83) ----
+
+    def cluster_up(self) -> bool:
+        with self._lock:
+            alive = [k for k in self._beats if k not in self._down]
+            shards = sum(1 for r, _ in alive if r == "shard")
+            sources = sum(1 for r, _ in alive if r == "source")
+        return (shards >= self.settings.min_shards
+                and sources >= self.settings.min_sources)
+
+    def await_up(self, timeout_s: float = 60.0, poll_s: float = 0.05) -> bool:
+        """Block until cluster_up (the Spout 'stateCheck' poll loop,
+        SpoutTrait.scala:70-88)."""
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            if self.cluster_up():
+                return True
+            _time.sleep(poll_s)
+        return self.cluster_up()

@@ -15,6 +15,7 @@ import threading
 import time as _time
 
 from ..ingestion.watermark import WatermarkRegistry
+from ..obs.metrics import METRICS
 from .events import EventLog
 from .snapshot import GraphView, build_view
 
@@ -70,8 +71,6 @@ class TemporalGraph:
             if hit is not None:
                 self._cache.move_to_end(key)
                 return hit
-        from ..obs.metrics import METRICS
-
         t0 = _time.perf_counter()
         view = build_view(self.log, int(time),
                           include_occurrences=include_occurrences)

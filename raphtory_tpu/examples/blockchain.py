@@ -73,7 +73,7 @@ class BitcoinBlockParser(Parser):
     def __call__(self, raw):
         try:
             return self._parse(raw)
-        except (KeyError, ValueError, TypeError):
+        except (KeyError, ValueError, TypeError, AttributeError):
             return []  # malformed block: dropped, never fatal to the source
 
     def _parse(self, raw):

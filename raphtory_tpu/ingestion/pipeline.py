@@ -111,10 +111,14 @@ class IngestionPipeline:
             METRICS.log_events.set(self.log.n)
             bt, bk, bs, bd, pending_props = [], [], [], [], []
 
+        dropped_ctr = METRICS.records_dropped.labels(source.name)
         for raw in source:
             if self._stop.is_set():
                 break
-            for u in parser(raw):
+            updates = parser(raw)
+            if not updates:  # malformed-or-filtered: visible, not fatal
+                dropped_ctr.inc()
+            for u in updates:
                 off = len(bt)
                 if isinstance(u, EdgeAdd):
                     bt.append(u.time); bk.append(ev.EDGE_ADD)

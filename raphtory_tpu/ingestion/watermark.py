@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import threading
 
+from ..obs.metrics import METRICS
+
 _NEG_INF = -(2**62)
 
 
@@ -45,8 +47,6 @@ class WatermarkRegistry:
     def _gauge_locked(self) -> None:
         # compute-and-set under _lock: a preempted thread must not clobber a
         # newer safe_time with a stale lower one
-        from ..obs.metrics import METRICS
-
         live = [w for s, w in self._marks.items() if s not in self._done]
         t = min(live) if live else 2**62
         if abs(t) < 2**62:  # only meaningful mid-stream values

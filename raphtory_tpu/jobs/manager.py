@@ -20,6 +20,7 @@ from dataclasses import dataclass
 from ..core.service import StaleViewError, TemporalGraph
 from ..engine import bsp
 from ..engine.program import VertexProgram
+from ..obs.metrics import METRICS
 
 
 @dataclass(frozen=True)
@@ -90,8 +91,6 @@ class Job:
     # ---- execution ----
 
     def _run(self) -> None:
-        from ..obs.metrics import METRICS
-
         METRICS.jobs_started.labels(type(self.query).__name__).inc()
         try:
             q = self.query
@@ -157,6 +156,7 @@ class Job:
         windows = q.windows
         if windows is not None:
             result, steps = self._execute(view, windows=list(windows))
+            METRICS.supersteps.inc(max(int(steps), 0))  # once per device run
             for i, w in enumerate(windows):
                 import jax
 
@@ -164,6 +164,7 @@ class Job:
                 self._emit(t, w, r_i, view, steps, t0)
         else:
             result, steps = self._execute(view, window=q.window)
+            METRICS.supersteps.inc(max(int(steps), 0))
             self._emit(t, q.window, result, view, steps, t0)
 
     def _execute(self, view, window=None, windows=None):
@@ -178,14 +179,11 @@ class Job:
         return bsp.run(self.program, view, window=window, windows=windows)
 
     def _emit(self, t, window, result, view, steps, t0) -> None:
-        from ..obs.metrics import METRICS
-
         reduced = self.program.reduce(result, view, window=window)
         # counted only after the host reduce: viewTime is END-TO-END (device
         # compute + reduce), and a failed reduce is not a computed view
         METRICS.views_computed.inc()
         METRICS.view_seconds.observe(_time.perf_counter() - t0)
-        METRICS.supersteps.inc(max(int(steps), 0))
         row = {
             "time": int(t),
             "windowsize": int(window) if window is not None else None,

@@ -40,7 +40,12 @@ class Metrics:
             "Graph update events appended to the log", ["source"], registry=r)
         self.parse_errors = Counter(
             "raphtory_parse_errors_total",
-            "Records a parser failed on", ["source"], registry=r)
+            "Fatal source errors (a source thread died)", ["source"],
+            registry=r)
+        self.records_dropped = Counter(
+            "raphtory_records_dropped_total",
+            "Records a parser produced no updates for (malformed or "
+            "filtered)", ["source"], registry=r)
         self.watermark = Gauge(
             "raphtory_watermark_safe_time",
             "Safe event time promised by all live sources", registry=r)

@@ -22,7 +22,11 @@ log→log rewrites:
 
 from __future__ import annotations
 
+import time as _time
+
 import numpy as np
+
+from ..obs.metrics import METRICS
 
 from ..core import events as ev
 from ..core.events import EventLog
@@ -246,10 +250,6 @@ class Archivist:
         # splice the concurrent tail back in compact_to — every holder of
         # the EventLog object (pipelines, views) sees the compacted history;
         # nothing is stranded or lost.
-        from ..obs.metrics import METRICS
-
-        import time as _time
-
         t0 = _time.perf_counter()
         frozen = log.freeze()
         span = log.max_time - log.min_time

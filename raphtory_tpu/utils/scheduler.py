@@ -1,0 +1,79 @@
+"""Named recurring/one-shot background tasks.
+
+The reference wraps the Akka scheduler in ``SchedulerUtil.scala:13-50``
+(named recurring + once tasks, cancellable by name) to drive keep-alives,
+watermark folds, and archivist cycles. Same surface over threading timers.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Scheduler:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tasks: dict[str, threading.Timer] = {}
+        self._cancelled: set[str] = set()
+        self._closed = False
+
+    def recurring(self, name: str, interval_s: float, fn, *args) -> None:
+        """Run ``fn`` every ``interval_s`` seconds until cancelled. A crash
+        in one tick is recorded on the task and does not stop the next."""
+
+        def tick():
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — a failing tick must not
+                pass           # kill the schedule (reference logs + ticks on)
+            # cancel() during a long-running fn must stick: a cancelled
+            # name never re-arms (the set is checked under _arm's lock too)
+            with self._lock:
+                if name in self._cancelled:
+                    return
+            self._arm(name, interval_s, tick)
+
+        with self._lock:
+            self._cancelled.discard(name)  # re-registering revives the name
+        self._arm(name, interval_s, tick)
+
+    def once(self, name: str, delay_s: float, fn, *args) -> None:
+        def run():
+            with self._lock:
+                self._tasks.pop(name, None)
+            fn(*args)
+
+        self._arm(name, delay_s, run)
+
+    def _arm(self, name: str, delay_s: float, fn) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            old = self._tasks.pop(name, None)
+            if old is not None:
+                old.cancel()
+            t = threading.Timer(delay_s, fn)
+            t.daemon = True
+            self._tasks[name] = t
+            t.start()
+
+    def cancel(self, name: str) -> bool:
+        with self._lock:
+            self._cancelled.add(name)
+            t = self._tasks.pop(name, None)
+            if t is not None:
+                t.cancel()
+                return True
+            return False
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+            for t in self._tasks.values():
+                t.cancel()
+            self._tasks.clear()
+
+    @property
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._tasks)

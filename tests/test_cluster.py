@@ -1,0 +1,163 @@
+"""Cluster control plane: watchdog, bootstrap topology, node runtime,
+config flags, scheduler (L6, §5.3, §5.6)."""
+
+import threading
+import time
+
+from raphtory_tpu.algorithms import ConnectedComponents
+from raphtory_tpu.cluster import NodeRuntime, WatchDog, bootstrap, topology
+from raphtory_tpu.ingestion.source import RandomSource
+from raphtory_tpu.jobs.manager import ViewQuery
+from raphtory_tpu.utils.config import Settings
+from raphtory_tpu.utils.scheduler import Scheduler
+
+
+# ---- watchdog ----
+
+def test_watchdog_ids_dense_and_growing():
+    wd = WatchDog()
+    assert [wd.join("shard") for _ in range(3)] == [0, 1, 2]
+    assert wd.join("source") == 0  # separate namespace per role
+    counts = []
+    wd.watch_counts(lambda role, n: counts.append((role, n)))
+    wd.join("shard")
+    assert ("shard", 4) in counts  # PartitionsCount republish
+
+
+def test_cluster_up_gate_blocks_until_quorum():
+    wd = WatchDog(Settings(min_shards=2, min_sources=1))
+    assert not wd.cluster_up()
+    wd.join("shard")
+    wd.join("source")
+    assert not wd.cluster_up()  # one shard short
+
+    flag = {}
+
+    def late_joiner():
+        time.sleep(0.1)
+        wd.join("shard")
+        flag["joined"] = True
+
+    threading.Thread(target=late_joiner).start()
+    assert wd.await_up(timeout_s=5.0)
+    assert flag["joined"]
+
+
+def test_staleness_and_auto_down_and_rejoin():
+    clk = {"t": 0.0}
+    wd = WatchDog(Settings(stale_after_s=30, auto_down_after_s=1200,
+                           min_shards=1, min_sources=0),
+                  clock=lambda: clk["t"])
+    sid = wd.join("shard")
+    assert wd.cluster_up()
+    clk["t"] = 31.0
+    assert wd.stale() == [("shard", sid, 31.0)]
+    assert wd.auto_down() == []          # stale but not yet downed
+    clk["t"] = 1201.0
+    assert wd.auto_down() == [("shard", sid)]
+    assert not wd.cluster_up()           # downed members leave the quorum
+    assert wd.members("shard") == []
+    wd.beat("shard", sid)                # phoenix: beating rejoins
+    assert wd.cluster_up()
+
+
+# ---- bootstrap ----
+
+def test_bootstrap_single_process_noop_and_topology():
+    assert bootstrap() is False  # no coordinator configured → single process
+    t = topology()
+    assert t.n_devices == 8 and t.platform == "cpu"
+    assert not t.multi_host and t.process_id == 0
+
+
+# ---- node runtime (SingleNodeSetup analogue) ----
+
+def test_node_runtime_end_to_end():
+    rt = NodeRuntime(Settings(archivist_interval_s=3600,
+                              heartbeat_interval_s=3600))
+    try:
+        rt.start()
+        rt.add_source(RandomSource(2_000, id_pool=150, seed=4, name="rt"))
+        assert rt.watchdog.cluster_up()
+        rt.ingest(wait=True)
+        assert not rt.pipeline.errors
+        job = rt.submit(ConnectedComponents(),
+                        ViewQuery(rt.graph.latest_time))
+        assert job.wait(120) and job.status == "done", job.error
+        assert job.results[0]["result"]["clusters"] >= 1
+    finally:
+        rt.stop()
+
+
+# ---- config flags ----
+
+def test_settings_from_env(monkeypatch):
+    monkeypatch.setenv("RAPHTORY_TPU_ARCHIVING", "false")
+    monkeypatch.setenv("RAPHTORY_TPU_MIN_SHARDS", "4")
+    monkeypatch.setenv("RAPHTORY_TPU_STALE_AFTER_S", "7.5")
+    monkeypatch.setenv("RAPHTORY_TPU_CHECKPOINT_DIR", "/tmp/ck")
+    s = Settings.from_env()
+    assert s.archiving is False
+    assert s.min_shards == 4
+    assert s.stale_after_s == 7.5
+    assert s.checkpoint_dir == "/tmp/ck"
+    assert s.compressing is True  # untouched default
+
+
+# ---- scheduler ----
+
+def test_scheduler_recurring_and_cancel():
+    sch = Scheduler()
+    hits = []
+    sch.recurring("tick", 0.05, hits.append, 1)
+    time.sleep(0.3)
+    assert sch.cancel("tick")
+    n = len(hits)
+    assert n >= 3
+    time.sleep(0.15)
+    assert len(hits) == n  # cancelled: no more ticks
+    done = threading.Event()
+    sch.once("boom", 0.01, done.set)
+    assert done.wait(2.0)
+    assert "boom" not in sch.names
+    sch.shutdown()
+
+
+def test_scheduler_survives_crashing_tick():
+    sch = Scheduler()
+    hits = []
+
+    def bad():
+        hits.append(1)
+        raise RuntimeError("tick crashed")
+
+    sch.recurring("bad", 0.05, bad)
+    time.sleep(0.25)
+    sch.shutdown()
+    assert len(hits) >= 2  # kept ticking after the crash
+
+
+def test_watchdog_rejects_unjoined_beat():
+    wd = WatchDog()
+    sid = wd.join("shard")
+    assert wd.beat("shard", sid)
+    assert not wd.beat("shard", 99)  # never joined: no phantom member
+    assert wd.members("shard") == [("shard", sid)]
+
+
+def test_scheduler_cancel_during_long_tick_sticks():
+    sch = Scheduler()
+    started = threading.Event()
+    hits = []
+
+    def slow():
+        hits.append(1)
+        started.set()
+        time.sleep(0.2)
+
+    sch.recurring("slow", 0.01, slow)
+    assert started.wait(2.0)
+    assert sch.cancel("slow") or True  # cancel lands mid-tick
+    time.sleep(0.5)
+    assert len(hits) == 1  # the running tick must NOT re-arm itself
+    sch.shutdown()

@@ -77,3 +77,36 @@ def test_profiler_annotation_and_trace(tmp_path):
         jnp.ones(8).sum().block_until_ready()
     # a trace directory with at least one artefact was produced
     assert any(tmp_path.rglob("*"))
+
+
+def test_records_dropped_counter():
+    from raphtory_tpu.ingestion.source import IterableSource
+    from raphtory_tpu.examples import RandomJsonParser
+
+    pipe = IngestionPipeline()
+    pipe.add_source(IterableSource(
+        ['{"VertexAdd":{"messageID":1,"srcID":2}}', "not json", "{}"],
+        name="drop1"), RandomJsonParser())
+    pipe.run()
+    assert not pipe.errors
+    assert _value(METRICS.records_dropped, ("drop1",)) == 2
+    assert _value(METRICS.events_ingested, ("drop1",)) == 1
+
+
+def test_supersteps_counted_once_per_batched_run():
+    from raphtory_tpu.core.service import TemporalGraph as TG
+    from raphtory_tpu.ingestion.source import RandomSource as RS
+    from raphtory_tpu.jobs.manager import AnalysisManager as AM, ViewQuery as VQ
+    from raphtory_tpu.algorithms import ConnectedComponents
+
+    pipe = IngestionPipeline()
+    pipe.add_source(RS(2_000, id_pool=100, seed=6, name="ss"))
+    pipe.run()
+    g = TG(pipe.log, pipe.watermarks)
+    before = _value(METRICS.supersteps)
+    job = AM(g).submit(ConnectedComponents(),
+                       VQ(g.latest_time, windows=(10_000, 1_000, 100)))
+    assert job.wait(120) and job.status == "done", job.error
+    steps = job.results[0]["steps"]
+    # three windows, ONE device run: counter advanced by steps, not 3*steps
+    assert _value(METRICS.supersteps) == before + steps
