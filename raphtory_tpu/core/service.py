@@ -83,6 +83,18 @@ class TemporalGraph:
                 self._cache.popitem(last=False)
         return view
 
+    def cache_put(self, time: int, view: GraphView,
+                  include_occurrences: bool = False) -> None:
+        """Insert an externally built view (e.g. a SweepBuilder hop) into the
+        shared cache so later view_at calls reuse it."""
+        METRICS.view_vertices.set(view.n_active)
+        METRICS.view_edges.set(view.m_active)
+        key = (self.log.version, int(time), include_occurrences)
+        with self._cache_lock:
+            self._cache[key] = view
+            while len(self._cache) > self._cache_size:
+                self._cache.popitem(last=False)
+
     # ---- maintenance ----
 
     def swap_log(self, new_log: EventLog) -> None:

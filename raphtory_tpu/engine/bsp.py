@@ -139,14 +139,17 @@ def _gather_props(view: GraphView, keys, kind: str):
     return out
 
 
-def run(
+def run_async(
     program: VertexProgram,
     view: GraphView,
     *,
     window: int | None = None,
     windows=None,
 ):
-    """Execute a vertex program against a view.
+    """Dispatch a vertex program against a view WITHOUT waiting for the
+    device: returns (result, steps) as device arrays. Range sweeps use this
+    to pipeline host snapshot builds with device compute — hop i+1's
+    snapshot folds while hop i's supersteps run.
 
     window=None, windows=None → plain view ({View,Range}AnalysisTask).
     window=w                  → single window (Windowed*).
@@ -211,4 +214,17 @@ def run(
     )
     if not batched:
         result = jax.tree_util.tree_map(lambda a: a[0], result)
+    return result, steps
+
+
+def run(
+    program: VertexProgram,
+    view: GraphView,
+    *,
+    window: int | None = None,
+    windows=None,
+):
+    """Blocking ``run_async``: waits for the device and returns
+    (result, int steps)."""
+    result, steps = run_async(program, view, window=window, windows=windows)
     return result, int(steps)

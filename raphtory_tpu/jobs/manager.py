@@ -97,9 +97,20 @@ class Job:
             if isinstance(q, ViewQuery):
                 self._run_at(q.timestamp, q)
             elif isinstance(q, RangeQuery):
+                # When the whole range is already safe, sweep incrementally
+                # (delta-applied snapshots, core/sweep.py) instead of
+                # re-folding the log per hop; otherwise hop-by-hop behind the
+                # watermark fence like the reference (RangeAnalysisTask).
+                sweep = None
+                if self.graph.safe_time() >= q.end:
+                    from ..core.sweep import SweepBuilder
+
+                    sweep = SweepBuilder(
+                        self.graph.log,
+                        include_occurrences=self.program.needs_occurrences)
                 t = q.start
                 while t <= q.end and not self._kill.is_set():
-                    self._run_at(t, q)
+                    self._run_at(t, q, sweep=sweep)
                     t += q.jump
             elif isinstance(q, LiveQuery):
                 self._run_live(q)
@@ -148,11 +159,18 @@ class Job:
             else:
                 self._kill.wait(q.repeat)
 
-    def _run_at(self, t: int, q, exact: bool = True) -> None:
+    def _run_at(self, t: int, q, exact: bool = True, sweep=None) -> None:
         t0 = _time.perf_counter()
-        view = self.graph.view_at(
-            int(t), exact=exact, wait_timeout=self.wait_timeout,
-            include_occurrences=self.program.needs_occurrences)
+        if sweep is not None:
+            s0 = _time.perf_counter()
+            view = sweep.view_at(int(t))
+            METRICS.snapshot_build_seconds.observe(_time.perf_counter() - s0)
+            self.graph.cache_put(
+                int(t), view, self.program.needs_occurrences)
+        else:
+            view = self.graph.view_at(
+                int(t), exact=exact, wait_timeout=self.wait_timeout,
+                include_occurrences=self.program.needs_occurrences)
         windows = q.windows
         if windows is not None:
             result, steps = self._execute(view, windows=list(windows))

@@ -44,7 +44,7 @@ def compress_events(log: EventLog, cutoff: int) -> EventLog:
     a droppable event must repeat its predecessor's aliveness in the merged
     stream. Events carrying properties are kept (their values feed later
     lookups)."""
-    from ..core.snapshot import _endpoint_tombstones
+    from ..core.snapshot import _endpoint_tombstones, _unique_pairs
 
     t = log.column("time")
     k = log.column("kind")
@@ -102,8 +102,8 @@ def compress_events(log: EventLog, cutoff: int) -> EventLog:
         np.ones(int(is_ea.sum()), bool), np.zeros(int(is_ed.sum()), bool)])
     e_own = np.concatenate([np.flatnonzero(is_ea), np.flatnonzero(is_ed)])
     if is_vd.any() and (is_ea.any() or is_ed.any()):
-        upairs = np.unique(np.stack([e_s, e_d], axis=1), axis=0)
-        ts_s, ts_d, ts_t = _endpoint_tombstones(upairs, s[is_vd], t[is_vd])
+        up_s, up_d = _unique_pairs(e_s, e_d)
+        ts_s, ts_d, ts_t = _endpoint_tombstones(up_s, up_d, s[is_vd], t[is_vd])
         e_s = np.concatenate([e_s, ts_s])
         e_d = np.concatenate([e_d, ts_d])
         e_t = np.concatenate([e_t, ts_t])

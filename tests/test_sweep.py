@@ -1,0 +1,148 @@
+"""SweepBuilder must emit views bit-identical to build_view at every hop."""
+
+import numpy as np
+import pytest
+
+from raphtory_tpu.core.events import EventLog
+from raphtory_tpu.core.snapshot import build_view
+from raphtory_tpu.core.sweep import SweepBuilder
+
+VIEW_FIELDS = [
+    "time", "n_pad", "m_pad", "n_active", "m_active",
+    "vids", "v_mask", "v_latest_time", "v_first_time",
+    "e_src", "e_dst", "e_mask", "e_latest_time", "e_first_time",
+    "out_order", "in_indptr", "out_indptr", "out_deg", "in_deg",
+]
+OCC_FIELDS = ["occ_src", "occ_dst", "occ_time", "occ_mask"]
+
+
+def random_log(rng, n_events=400, n_ids=12, t_span=50, props=False):
+    """Adversarial log: heavy id reuse, duplicate timestamps, deletes of
+    vertices/edges, arrival order decoupled from event time."""
+    log = EventLog()
+    for _ in range(n_events):
+        kind = rng.choice(4, p=[0.25, 0.1, 0.5, 0.15])
+        t = int(rng.integers(0, t_span))
+        a = int(rng.integers(0, n_ids))
+        b = int(rng.integers(0, n_ids))
+        p = None
+        if props and rng.random() < 0.4:
+            p = {"w": float(rng.integers(0, 5)), "!kind": float(a % 3)}
+        if kind == 0:
+            log.add_vertex(t, a, p)
+        elif kind == 1:
+            log.delete_vertex(t, a)
+        elif kind == 2:
+            log.add_edge(t, a, b, p)
+        else:
+            log.delete_edge(t, a, b)
+    return log
+
+
+def assert_views_equal(got, want, occurrences=False):
+    fields = VIEW_FIELDS + (OCC_FIELDS if occurrences else [])
+    for f in fields:
+        g, w = getattr(got, f), getattr(want, f)
+        if isinstance(w, (int, np.integer)):
+            assert g == w, f"{f}: {g} != {w}"
+        else:
+            np.testing.assert_array_equal(g, w, err_msg=f"field {f}")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_sweep_matches_full_build(seed):
+    rng = np.random.default_rng(seed)
+    log = random_log(rng)
+    times = sorted(rng.choice(55, size=9, replace=False).tolist())
+    sweep = SweepBuilder(log)
+    for T in times:
+        assert_views_equal(sweep.view_at(int(T)), build_view(log, int(T)))
+
+
+def test_sweep_repeated_and_descending_times():
+    rng = np.random.default_rng(7)
+    log = random_log(rng)
+    sweep = SweepBuilder(log)
+    for T in [10, 10, 30, 20, 30, 49]:  # repeats + a backward hop (fallback)
+        assert_views_equal(sweep.view_at(T), build_view(log, T))
+
+
+def test_sweep_properties_join(tmp_path):
+    rng = np.random.default_rng(11)
+    log = random_log(rng, props=True)
+    sweep = SweepBuilder(log)
+    for T in [15, 35, 49]:
+        got = sweep.view_at(T)
+        want = build_view(log, T)
+        assert_views_equal(got, want)
+        np.testing.assert_array_equal(got.vertex_prop("w"), want.vertex_prop("w"))
+        np.testing.assert_array_equal(got.edge_prop("w"), want.edge_prop("w"))
+        np.testing.assert_array_equal(
+            got.vertex_prop("kind"), want.vertex_prop("kind"))
+
+
+def test_sweep_occurrences():
+    rng = np.random.default_rng(13)
+    log = random_log(rng)
+    sweep = SweepBuilder(log, include_occurrences=True)
+    for T in [12, 25, 49]:
+        got = sweep.view_at(T)
+        want = build_view(log, T, include_occurrences=True)
+        assert_views_equal(got, want, occurrences=True)
+
+
+def test_sweep_empty_and_sparse_hops():
+    log = EventLog()
+    log.add_edge(100, 1, 2)
+    log.add_vertex(200, 3)
+    log.delete_vertex(300, 1)
+    sweep = SweepBuilder(log)
+    for T in [0, 50, 100, 150, 250, 300, 1000]:
+        assert_views_equal(sweep.view_at(T), build_view(log, T))
+
+
+def test_sweep_negative_vertex_ids():
+    """assign_id hashes strings to SIGNED int64 — negative ids are real
+    vertices and must not be conflated with the -1 dst sentinel."""
+    log = EventLog()
+    log.add_edge(1, 5, -7)          # -7 appears only as a dst
+    log.add_vertex(2, -3)
+    log.add_edge(3, -3, -7)
+    log.delete_vertex(4, -7)
+    sweep = SweepBuilder(log)
+    for T in [1, 2, 3, 4]:
+        assert_views_equal(sweep.view_at(T), build_view(log, T))
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_sweep_matches_full_build_signed_ids(seed):
+    rng = np.random.default_rng(seed)
+    log = EventLog()
+    ids = rng.integers(-(2**62), 2**62, size=10)  # hashed-style signed ids
+    for _ in range(300):
+        kind = rng.choice(4, p=[0.25, 0.1, 0.5, 0.15])
+        t = int(rng.integers(0, 40))
+        a = int(ids[rng.integers(0, len(ids))])
+        b = int(ids[rng.integers(0, len(ids))])
+        [log.add_vertex, log.delete_vertex,
+         lambda t, a: log.add_edge(t, a, b),
+         lambda t, a: log.delete_edge(t, a, b)][kind](t, a)
+    sweep = SweepBuilder(log)
+    for T in [5, 15, 25, 39]:
+        assert_views_equal(sweep.view_at(T), build_view(log, T))
+
+
+def test_sweep_vertex_delete_tombstones_future_edges():
+    """A vertex delete must tombstone edges first seen in LATER hops too
+    (killList merges historical deaths into new edges, Edge.scala:36-44)."""
+    log = EventLog()
+    log.delete_vertex(10, 1)
+    log.add_edge(5, 1, 2)    # add BEFORE the delete (by event time)
+    log.add_edge(20, 1, 3)   # add after
+    sweep = SweepBuilder(log)
+    for T in [7, 12, 25]:
+        assert_views_equal(sweep.view_at(T), build_view(log, T))
+    v = sweep.view_at(30)
+    # edge (1,2): latest mark is the delete at 10 → dead; (1,3) alive
+    w = build_view(log, 30)
+    assert v.m_active == w.m_active
