@@ -29,6 +29,9 @@ class ConnectedComponents(VertexProgram):
     max_steps: int = 100
     combiner = "min"
     direction = "both"
+    needs_vids = False
+    needs_vertex_times = False
+    needs_edge_times = False
 
     def init(self, ctx: Context):
         return jnp.where(ctx.v_mask, ctx.global_index(), _I32_MAX)

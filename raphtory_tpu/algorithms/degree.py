@@ -19,6 +19,9 @@ from ..engine.program import Context, VertexProgram
 @dataclass(frozen=True)
 class DegreeBasic(VertexProgram):
     max_steps: int = 0
+    needs_vids = False
+    needs_vertex_times = False
+    needs_edge_times = False
 
     def init(self, ctx: Context):
         return {}

@@ -3,8 +3,9 @@
 Parity with ``core/analysis/Algorithms/BinaryDefusion.scala`` (sic): a random
 seed vertex is infected; each superstep every infected vertex infects a
 random subset of its out-neighbours; runs until quiescence. Randomness is
-counter-based (``jax.random.fold_in`` of seed, superstep and edge index) so
-the program stays a pure function — reruns reproduce exactly.
+counter-based (an integer hash of seed, superstep and edge endpoints) so the
+program stays a pure function — reruns reproduce exactly, independent of how
+the engine lays out the window batch.
 """
 
 from __future__ import annotations
@@ -26,6 +27,8 @@ class BinaryDiffusion(VertexProgram):
     max_steps: int = 50
     combiner = "max"
     direction = "out"
+    needs_vertex_times = False
+    needs_edge_times = False
 
     def init(self, ctx: Context):
         if self.seeds:
@@ -40,9 +43,22 @@ class BinaryDiffusion(VertexProgram):
         return (infected & ctx.v_mask).astype(jnp.int32)
 
     def message(self, src_state, edge: Edges):
-        m = edge.src.shape[0]
-        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), edge.step)
-        coin = jax.random.uniform(key, (m,)) < self.spread_prob
+        # Counter-based coin per (edge endpoints, superstep, seed): a pure
+        # integer hash, NOT jax.random over the array shape — the engine may
+        # lay the window batch out flat (k*m), and position-based draws
+        # would then give each window different coins (batched runs would
+        # diverge from single-window runs). Hashing the edge's endpoints
+        # keeps draws identical across layouts and duplicate windows.
+        h = (edge.src.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+             ^ edge.dst.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+             ^ (edge.step.astype(jnp.uint32) + jnp.uint32(self.seed))
+             * jnp.uint32(0xC2B2AE3D))
+        h = h ^ (h >> 15)
+        h = h * jnp.uint32(0x2C1B3C6D)
+        h = h ^ (h >> 12)
+        h = h * jnp.uint32(0x297A2D39)
+        h = h ^ (h >> 15)
+        coin = (h.astype(jnp.float32) / jnp.float32(2**32)) < self.spread_prob
         return jnp.where(coin, src_state, 0)
 
     def update(self, state, agg, ctx: Context):

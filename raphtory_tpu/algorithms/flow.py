@@ -19,6 +19,9 @@ from ..engine.program import Context, VertexProgram
 
 @dataclass(frozen=True)
 class FlowGraph(VertexProgram):
+    needs_vids = False
+    needs_vertex_times = False
+    needs_edge_times = False
     flow_prop: str = "flow"
     default_flow: float = 1.0
     max_steps: int = 0

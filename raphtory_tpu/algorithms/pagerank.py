@@ -24,6 +24,9 @@ class PageRank(VertexProgram):
     max_steps: int = 50
     combiner = "sum"
     direction = "out"   # payload flows src→dst, combined at dst = pull at dst
+    needs_vids = False
+    needs_vertex_times = False
+    needs_edge_times = False
 
     def init(self, ctx: Context):
         n = jnp.maximum(ctx.num_vertices, 1.0)

@@ -17,6 +17,9 @@ from ..engine.program import Context, VertexProgram
 
 @dataclass(frozen=True)
 class DegreeRanking(VertexProgram):
+    needs_vids = False
+    needs_vertex_times = False
+    needs_edge_times = False
     top_k: int = 10
     by: str = "total"   # 'in' | 'out' | 'total'
     max_steps: int = 0
@@ -48,6 +51,9 @@ class DegreeRanking(VertexProgram):
 
 @dataclass(frozen=True)
 class StarNode(VertexProgram):
+    needs_vids = False
+    needs_vertex_times = False
+    needs_edge_times = False
     """The vertex with maximum in-degree in the (windowed) view — parity with
     the random example's ``StarNode`` analyser
     (``examples/random/depricated/StarNode.scala``)."""
@@ -75,6 +81,9 @@ class StarNode(VertexProgram):
 
 @dataclass(frozen=True)
 class Density(VertexProgram):
+    needs_vids = False
+    needs_vertex_times = False
+    needs_edge_times = False
     """|E| / (|V| * (|V|-1)) on the (windowed) view."""
 
     max_steps: int = 0

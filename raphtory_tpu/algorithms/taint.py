@@ -42,6 +42,7 @@ class TaintTracking(VertexProgram):
     combiner = "min"
     direction = "out"
     needs_occurrences = True
+    needs_vertex_times = False
 
     def init(self, ctx: Context):
         tainted = _member(ctx.vids, self.seeds) & ctx.v_mask

@@ -31,6 +31,8 @@ class SSSP(VertexProgram):
     directed: bool = True
     max_steps: int = 100
     combiner = "min"
+    needs_vertex_times = False
+    needs_edge_times = False
 
     @property
     def direction(self):  # type: ignore[override]

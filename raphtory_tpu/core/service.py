@@ -65,7 +65,8 @@ class TemporalGraph:
                         f"view at {time} not yet safe: watermark="
                         f"{self.safe_time()} ({self.watermarks.snapshot()})")
                 _time.sleep(min(0.05, wait_timeout))
-        key = (self.log.version, int(time), include_occurrences)
+        version = self.log.version
+        key = (version, int(time), include_occurrences)
         with self._cache_lock:
             hit = self._cache.get(key)
             if hit is not None:
@@ -75,21 +76,23 @@ class TemporalGraph:
         view = build_view(self.log, int(time),
                           include_occurrences=include_occurrences)
         METRICS.snapshot_build_seconds.observe(_time.perf_counter() - t0)
-        METRICS.view_vertices.set(view.n_active)
-        METRICS.view_edges.set(view.m_active)
-        with self._cache_lock:
-            self._cache[key] = view
-            while len(self._cache) > self._cache_size:
-                self._cache.popitem(last=False)
+        self.cache_put(int(time), view, include_occurrences, version=version)
         return view
 
     def cache_put(self, time: int, view: GraphView,
-                  include_occurrences: bool = False) -> None:
+                  include_occurrences: bool = False, *,
+                  version: int | None = None) -> None:
         """Insert an externally built view (e.g. a SweepBuilder hop) into the
-        shared cache so later view_at calls reuse it."""
+        shared cache so later view_at calls reuse it. `version` must be the
+        log version the view was BUILT from (a sweep's pinned log), not the
+        current one — a compaction between build and insert would otherwise
+        file a pre-compaction view under the post-compaction key, undoing
+        invalidate_cache()."""
         METRICS.view_vertices.set(view.n_active)
         METRICS.view_edges.set(view.m_active)
-        key = (self.log.version, int(time), include_occurrences)
+        if version is None:
+            version = self.log.version
+        key = (version, int(time), include_occurrences)
         with self._cache_lock:
             self._cache[key] = view
             while len(self._cache) > self._cache_size:

@@ -85,6 +85,11 @@ class SweepBuilder:
         # delete history: (dense vertex, time), sorted by vertex
         self.dh_v = np.empty(0, np.int64)
         self.dh_t = np.empty(0, np.int64)
+        # in-time add-event row lists (property joins), ascending, grown
+        # per delta — deltas are selected by event TIME, so their row
+        # indices interleave with earlier hops' and need a sorted merge
+        self._ea_rows = np.empty(0, np.int64)
+        self._va_rows = np.empty(0, np.int64)
         self.t_prev: int | None = None
 
     # ---- helpers ----
@@ -136,6 +141,13 @@ class SweepBuilder:
         is_vd = k == VERTEX_DELETE
         is_ea = k == EDGE_ADD
         is_ed = k == EDGE_DELETE
+
+        new_ea = rows[is_ea]
+        new_va = rows[is_va]
+        self._ea_rows = np.insert(
+            self._ea_rows, np.searchsorted(self._ea_rows, new_ea), new_ea)
+        self._va_rows = np.insert(
+            self._va_rows, np.searchsorted(self._va_rows, new_va), new_va)
 
         ds_ea = self._dense(s[is_ea])
         dd_ea = self._dense(d[is_ea])
@@ -257,9 +269,8 @@ class SweepBuilder:
             (dst_loc.astype(np.int64) << _ENC_SHIFT) | src_loc, kind="stable")
         locs = (src_loc, dst_loc, eorder)
 
-        intime = self._t <= time
-        eadd_rows = np.flatnonzero(intime & (self._k == EDGE_ADD))
-        vadd_rows = np.flatnonzero(intime & (self._k == VERTEX_ADD))
+        eadd_rows = self._ea_rows
+        vadd_rows = self._va_rows
         occ = None
         if self.include_occurrences:
             occ = (eadd_rows, self._t[eadd_rows],

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.snapshot import GraphView
-from ..ops.segment import combine_tree, segment_combine
+from ..ops.segment import segment_combine
 from .program import Context, Edges, VertexProgram
 
 _elem = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
@@ -33,39 +33,71 @@ def _merge_aggs(op: str, a, b):
     return jax.tree_util.tree_map(_elem[op], a, b)
 
 
+def _unpack_bits(packed: jnp.ndarray, n: int) -> jnp.ndarray:
+    """u8[k, n//8] (little bit order) → bool[k, n]. Window masks ship to the
+    device bit-packed: on a host with few cores, H2D staging competes with
+    the snapshot builds of a range sweep, so bytes on the wire matter."""
+    bits = (packed[:, :, None] >> jnp.arange(8, dtype=packed.dtype)) & 1
+    return bits.reshape(packed.shape[0], n).astype(bool)
+
+
 def make_runner(program: VertexProgram, n: int, m: int, k: int):
     """The raw (unjitted) superstep program for given padded shapes — the
-    jittable forward step of the framework; see also ``__graft_entry__``."""
+    jittable forward step of the framework; see also ``__graft_entry__``.
 
-    def one_superstep(state, v_mask, e_mask, out_deg, in_deg, ctx, edges):
-        agg = None
-        if program.direction in ("out", "both"):
-            src_state = jax.tree_util.tree_map(lambda a: a[edges.src], state)
-            payload = program.message(src_state, edges)
-            agg = combine_tree(payload, edges.dst, n, program.combiner,
-                               e_mask, indices_are_sorted=True)
-        if program.direction in ("in", "both"):
-            src_state = jax.tree_util.tree_map(lambda a: a[edges.dst], state)
-            payload = program.message(src_state, edges)
-            agg_in = combine_tree(payload, edges.src, n, program.combiner,
-                                  e_mask, indices_are_sorted=False)
-            agg = agg_in if agg is None else _merge_aggs(program.combiner, agg, agg_in)
-        new_state, votes = program.update(state, agg, ctx)
-        halted = jnp.all(votes | ~v_mask)
-        return new_state, halted
+    The returned fn takes BIT-PACKED masks (u8[k, n//8] / u8[k, m//8],
+    little bit order). Arrays a program opts out of (``needs_vids`` /
+    ``needs_vertex_times`` / ``needs_edge_times`` False) may be passed as
+    1-element dummies — the runner substitutes pad defaults on device, so
+    the host never stages or transfers them.
 
-    def run(v_masks, e_masks, vids, v_latest, v_first,
+    The window batch is evaluated as ONE FLAT graph of k*n vertices / k*m
+    edges (per-window segment ids offset by kk*n) rather than vmapping the
+    gather/segment-combine per window: one scatter instead of k batched
+    scatters. This is also a deliberate dodge of a TPU backend miscompile
+    observed with [vmapped scatter inside a while_loop whose condition
+    depends on carried state] — with the flat layout the halt-early
+    condition is safe (verified against host references in
+    tests/test_engine_algorithms.py::
+    test_pagerank_batched_windows_match_single)."""
+
+    def run(v_masks_p, e_masks_p, vids, v_latest, v_first,
             e_src, e_dst, e_latest, e_first,
             time, windows, eprops, vprops):
-        # per-window degrees: one segment-sum over the masked edge set
-        ones = jnp.ones((m,), jnp.int32)
+        v_masks = _unpack_bits(v_masks_p, n)
+        e_masks = _unpack_bits(e_masks_p, m)
+        if not program.needs_vids:
+            vids = jnp.full((n,), -1, jnp.int64)
+        if not program.needs_vertex_times:
+            v_latest = jnp.full((n,), jnp.iinfo(jnp.int64).min, jnp.int64)
+            v_first = v_latest
+        if not program.needs_edge_times:
+            e_latest = jnp.full((m,), jnp.iinfo(jnp.int64).min, jnp.int64)
+            e_first = e_latest
 
-        def degs(em):
-            ind = segment_combine(ones, e_dst, n, "sum", em, True)
-            out = segment_combine(ones, e_src, n, "sum", em, False)
-            return out, ind
+        # flat (window-major) edge space: ids offset by kk*n
+        voffs = (jnp.arange(k, dtype=jnp.int32) * n)[:, None]
+        flat_dst = (e_dst[None, :] + voffs).reshape(-1)   # [k*m]; dst-sorted
+        flat_src = (e_src[None, :] + voffs).reshape(-1)   # per window block
+        em_flat = e_masks.reshape(-1)
 
-        out_deg, in_deg = jax.vmap(degs)(e_masks)
+        def tile_e(a):
+            return jnp.broadcast_to(a[None, :], (k,) + a.shape).reshape(
+                (k * m,) + a.shape[1:])
+
+        def combine_flat(tree_flat, ids, sorted_):
+            def leaf(x):
+                out = segment_combine(x, ids, k * n, program.combiner,
+                                      em_flat, indices_are_sorted=sorted_)
+                return out.reshape((k, n) + x.shape[1:])
+            return jax.tree_util.tree_map(leaf, tree_flat)
+
+        # per-window degrees: one flat segment-sum over the masked edge set
+        ones_flat = jnp.ones((k * m,), jnp.int32)
+        in_deg = segment_combine(ones_flat, flat_dst, k * n, "sum",
+                                 em_flat, True).reshape(k, n)
+        out_deg = segment_combine(ones_flat, flat_src, k * n, "sum",
+                                  em_flat, False).reshape(k, n)
 
         def mk_ctx(kk, step):
             return Context(
@@ -81,14 +113,35 @@ def make_runner(program: VertexProgram, n: int, m: int, k: int):
 
         state0 = jax.vmap(init_k)(jnp.arange(k))
 
-        def step_k(kk, st, step):
-            ctx = mk_ctx(kk, step)
-            ek = Edges(src=e_src, dst=e_dst, mask=e_masks[kk], time=e_latest,
-                       first_time=e_first, props=eprops, step=step)
-            return one_superstep(st, v_masks[kk], e_masks[kk],
-                                 out_deg[kk], in_deg[kk], ctx, ek)
+        def flat_edges(step):
+            # Edges contract: src/dst are the per-window vertex indices
+            # (programs compare them, e.g. self-loop drops) — NOT offset
+            return Edges(src=tile_e(e_src), dst=tile_e(e_dst), mask=em_flat,
+                         time=tile_e(e_latest), first_time=tile_e(e_first),
+                         props=jax.tree_util.tree_map(tile_e, eprops),
+                         step=step)
 
-        vstep = jax.vmap(step_k, in_axes=(0, 0, None))
+        def gather_flat(state, ids):
+            return jax.tree_util.tree_map(
+                lambda a: a.reshape((k * n,) + a.shape[2:])[ids], state)
+
+        def step_all(st, step):
+            ek = flat_edges(step)
+            agg = None
+            if program.direction in ("out", "both"):
+                payload = program.message(gather_flat(st, flat_src), ek)
+                agg = combine_flat(payload, flat_dst, True)
+            if program.direction in ("in", "both"):
+                payload = program.message(gather_flat(st, flat_dst), ek)
+                agg_in = combine_flat(payload, flat_src, False)
+                agg = agg_in if agg is None else _merge_aggs(
+                    program.combiner, agg, agg_in)
+
+            def upd_k(kk, stk, aggk):
+                new, votes = program.update(stk, aggk, mk_ctx(kk, step))
+                return new, jnp.all(votes | ~v_masks[kk])
+
+            return jax.vmap(upd_k, in_axes=(0, 0, 0))(jnp.arange(k), st, agg)
 
         if program.max_steps > 0:
             def cond(carry):
@@ -97,7 +150,7 @@ def make_runner(program: VertexProgram, n: int, m: int, k: int):
 
             def body(carry):
                 step, st, halted = carry
-                new_st, new_halt = vstep(jnp.arange(k), st, step)
+                new_st, new_halt = step_all(st, step)
                 # freeze halted windows
                 st = jax.tree_util.tree_map(
                     lambda old, new: jnp.where(
@@ -204,12 +257,18 @@ def run_async(
     vprops = _gather_props(view, program.vertex_props, "v")
     win_arr = jnp.asarray([(-1 if w is None else int(w)) for w in wlist], jnp.int64)
 
+    dummy64 = jnp.zeros((1,), jnp.int64)
     result, steps = runner(
-        jnp.asarray(v_masks), jnp.asarray(e_masks),
-        jnp.asarray(view.vids), jnp.asarray(view.v_latest_time),
-        jnp.asarray(view.v_first_time),
+        jnp.asarray(np.packbits(v_masks, axis=1, bitorder="little")),
+        jnp.asarray(np.packbits(e_masks, axis=1, bitorder="little")),
+        jnp.asarray(view.vids) if program.needs_vids else dummy64,
+        (jnp.asarray(view.v_latest_time)
+         if program.needs_vertex_times else dummy64),
+        (jnp.asarray(view.v_first_time)
+         if program.needs_vertex_times else dummy64),
         jnp.asarray(e_src), jnp.asarray(e_dst),
-        jnp.asarray(e_latest), jnp.asarray(e_first),
+        jnp.asarray(e_latest) if program.needs_edge_times else dummy64,
+        jnp.asarray(e_first) if program.needs_edge_times else dummy64,
         jnp.asarray(view.time, jnp.int64), win_arr, eprops, vprops,
     )
     if not batched:

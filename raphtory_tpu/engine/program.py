@@ -115,6 +115,16 @@ class VertexProgram:
     edge_props: tuple[str, ...] = ()
     vertex_props: tuple[str, ...] = ()
     needs_occurrences: bool = False  # multigraph temporal algorithms
+    # Array-requirement declarations. Defaults are conservative (everything
+    # ships to the device); a program that never reads ctx.vids /
+    # ctx.v_{latest,first}_time / edge.{time,first_time} on device should
+    # set the matching flag False — the engine then skips staging and
+    # transferring those arrays entirely (a large share of per-hop H2D bytes
+    # in range sweeps). With a flag False the corresponding ctx/edge fields
+    # hold pad defaults (-1 / INT64_MIN) on device.
+    needs_vids: bool = True
+    needs_vertex_times: bool = True
+    needs_edge_times: bool = True
 
     # -- pure array functions --
 

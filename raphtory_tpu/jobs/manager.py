@@ -166,7 +166,8 @@ class Job:
             view = sweep.view_at(int(t))
             METRICS.snapshot_build_seconds.observe(_time.perf_counter() - s0)
             self.graph.cache_put(
-                int(t), view, self.program.needs_occurrences)
+                int(t), view, self.program.needs_occurrences,
+                version=sweep.log.version)
         else:
             view = self.graph.view_at(
                 int(t), exact=exact, wait_timeout=self.wait_timeout,

@@ -177,3 +177,37 @@ def test_empty_view_runs():
     labels, _ = bsp.run(ConnectedComponents(), view)
     stats = ConnectedComponents().reduce(np.asarray(labels), view)
     assert stats["vertices"] == 0 and stats["clusters"] == 0
+
+
+def test_pagerank_batched_windows_match_single():
+    """Batched windows must yield the SAME VALUES as one-window runs — the
+    k>=2 path uses a flat offset-id segment layout (one scatter for all
+    windows) and must stay numerically identical to the k=1 path."""
+    log = _random_log(11)
+    view = build_view(log, 95)
+    windows = [100, 40, 40, 10]
+    pr = PageRank(max_steps=30, tol=0.0)
+    batched, _ = bsp.run(pr, view, windows=windows)
+    batched = np.asarray(batched)
+    for i, w in enumerate(windows):
+        single, _ = bsp.run(pr, view, window=w)
+        np.testing.assert_allclose(batched[i], np.asarray(single), atol=1e-6,
+                                   err_msg=f"window {w}")
+        np.testing.assert_allclose(batched[i].sum(), 1.0, atol=1e-3)
+    # duplicate windows must agree exactly
+    np.testing.assert_array_equal(batched[1], batched[2])
+
+
+def test_diffusion_batched_matches_single():
+    """Coin draws hash edge endpoints, not array positions — duplicate
+    windows and the k=1 path must produce identical infection sets."""
+    from raphtory_tpu.algorithms import BinaryDiffusion
+
+    log = _random_log(5)
+    view = build_view(log, 95)
+    prog = BinaryDiffusion(seeds=(1,), seed=7, max_steps=8)
+    batched, _ = bsp.run(prog, view, windows=[100, 100, 20])
+    batched = np.asarray(batched)
+    np.testing.assert_array_equal(batched[0], batched[1])
+    single, _ = bsp.run(prog, view, window=100)
+    np.testing.assert_array_equal(batched[0], np.asarray(single))
