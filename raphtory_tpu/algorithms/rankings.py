@@ -51,13 +51,13 @@ class DegreeRanking(VertexProgram):
 
 @dataclass(frozen=True)
 class StarNode(VertexProgram):
-    needs_vids = False
-    needs_vertex_times = False
-    needs_edge_times = False
     """The vertex with maximum in-degree in the (windowed) view — parity with
     the random example's ``StarNode`` analyser
     (``examples/random/depricated/StarNode.scala``)."""
 
+    needs_vids = False
+    needs_vertex_times = False
+    needs_edge_times = False
     max_steps: int = 0
 
     def init(self, ctx: Context):
@@ -81,11 +81,11 @@ class StarNode(VertexProgram):
 
 @dataclass(frozen=True)
 class Density(VertexProgram):
+    """|E| / (|V| * (|V|-1)) on the (windowed) view."""
+
     needs_vids = False
     needs_vertex_times = False
     needs_edge_times = False
-    """|E| / (|V| * (|V|-1)) on the (windowed) view."""
-
     max_steps: int = 0
 
     def init(self, ctx: Context):

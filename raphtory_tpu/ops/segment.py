@@ -56,14 +56,3 @@ def segment_combine(
         data, segment_ids, num_segments=num_segments,
         indices_are_sorted=indices_are_sorted,
     )
-
-
-def combine_tree(tree, segment_ids, num_segments, op, mask=None,
-                 indices_are_sorted: bool = True):
-    """segment_combine over a pytree of payloads (one op for all leaves)."""
-    return jax.tree_util.tree_map(
-        lambda x: segment_combine(
-            x, segment_ids, num_segments, op, mask, indices_are_sorted
-        ),
-        tree,
-    )

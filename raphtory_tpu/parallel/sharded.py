@@ -38,7 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..core.snapshot import GraphView, INT64_MIN
 from ..engine.bsp import _elem, _merge_aggs
 from ..engine.program import Context, Edges, VertexProgram
-from ..ops.segment import combine_tree, segment_combine
+from ..ops.segment import segment_combine
 
 V_AXIS = "vertices"
 W_AXIS = "windows"
@@ -161,8 +161,11 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
     reduce_axes = (W_AXIS, V_AXIS)
 
     def gather_state(state_loc):
+        # state leaves are [k_loc, n_loc, ...]: the vertex axis is axis 1
+        # (axis 0 is the local window batch) — tiled gather concatenates the
+        # contiguous range partitions back into global vertex order
         return jax.tree_util.tree_map(
-            lambda a: jax.lax.all_gather(a, V_AXIS, axis=0, tiled=True),
+            lambda a: jax.lax.all_gather(a, V_AXIS, axis=1, tiled=True),
             state_loc)
 
     def device_fn(v_mask, vids, v_latest, v_first,
@@ -172,15 +175,43 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
         # shapes (per device): v_mask [Kl, n_loc]; d_* [m_loc_d] / masks
         # [Kl, m_loc_d]; windows [Kl]
         v_off = jax.lax.axis_index(V_AXIS).astype(jnp.int32) * n_loc
-        ones_d = jnp.ones((m_loc_d,), jnp.int32)
-        ones_s = jnp.ones((m_loc_s,), jnp.int32)
 
-        def degs(dm, sm):
-            in_deg = segment_combine(ones_d, d_dst_l, n_loc, "sum", dm, True)
-            out_deg = segment_combine(ones_s, s_src_l, n_loc, "sum", sm, True)
-            return out_deg, in_deg
+        # Flat window-major layout: the window batch is ONE graph of
+        # k_loc*n_loc local vertices, per-window segment ids offset by
+        # kk*n_loc. One scatter for all windows — and no vmapped scatter
+        # inside the superstep while_loop, the shape that miscompiles on
+        # the TPU backend when the loop condition reads carried state
+        # (see engine/bsp.py make_runner).
+        woffs_loc = (jnp.arange(k_loc, dtype=jnp.int32) * n_loc)[:, None]
+        woffs_pad = (jnp.arange(k_loc, dtype=jnp.int32) * n_pad)[:, None]
+        fl_d_dst = (d_dst_l[None, :] + woffs_loc).reshape(-1)  # sorted/blk
+        fl_d_src = (d_src_g[None, :] + woffs_pad).reshape(-1)  # into st_full
+        fl_s_src = (s_src_l[None, :] + woffs_loc).reshape(-1)  # sorted/blk
+        fl_s_dst = (s_dst_g[None, :] + woffs_pad).reshape(-1)
+        dm_flat = d_mask.reshape(-1)
+        sm_flat = s_mask.reshape(-1)
 
-        out_deg, in_deg = jax.vmap(degs)(d_mask, s_mask)
+        def tile_d(a):
+            return jnp.broadcast_to(a[None, :], (k_loc,) + a.shape).reshape(
+                (k_loc * m_loc_d,) + a.shape[1:])
+
+        def tile_s(a):
+            return jnp.broadcast_to(a[None, :], (k_loc,) + a.shape).reshape(
+                (k_loc * m_loc_s,) + a.shape[1:])
+
+        def combine_flat(tree_flat, ids, msk):
+            def leaf(x):
+                out = segment_combine(x, ids, k_loc * n_loc, program.combiner,
+                                      msk, indices_are_sorted=True)
+                return out.reshape((k_loc, n_loc) + x.shape[1:])
+            return jax.tree_util.tree_map(leaf, tree_flat)
+
+        in_deg = segment_combine(
+            jnp.ones((k_loc * m_loc_d,), jnp.int32), fl_d_dst,
+            k_loc * n_loc, "sum", dm_flat, True).reshape(k_loc, n_loc)
+        out_deg = segment_combine(
+            jnp.ones((k_loc * m_loc_s,), jnp.int32), fl_s_src,
+            k_loc * n_loc, "sum", sm_flat, True).reshape(k_loc, n_loc)
 
         def mk_ctx(kk, step):
             n_act = jnp.sum(v_mask[kk].astype(jnp.int32))
@@ -197,38 +228,43 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
 
         state0 = jax.vmap(init_k)(jnp.arange(k_loc))
 
-        def one_step(kk, st, step):
-            ctx = mk_ctx(kk, step)
-            st_full = gather_state(st)  # [n_pad, ...]
+        def gather_flat(st_full, ids):
+            return jax.tree_util.tree_map(
+                lambda a: a.reshape((k_loc * n_pad,) + a.shape[2:])[ids],
+                st_full)
+
+        def step_all(st, step):
+            st_full = gather_state(st)  # [k_loc, n_pad, ...]
             agg = None
             if program.direction in ("out", "both"):
-                src_state = jax.tree_util.tree_map(
-                    lambda a: a[d_src_g], st_full)
                 # Edges contract: src/dst are GLOBAL padded indices
-                edges = Edges(src=d_src_g, dst=d_dst_l + v_off,
-                              mask=d_mask[kk], time=d_time,
-                              first_time=d_first, props=d_props, step=step)
-                payload = program.message(src_state, edges)
-                agg = combine_tree(payload, d_dst_l, n_loc, program.combiner,
-                                   d_mask[kk], indices_are_sorted=True)
+                edges = Edges(src=tile_d(d_src_g), dst=tile_d(d_dst_l) + v_off,
+                              mask=dm_flat, time=tile_d(d_time),
+                              first_time=tile_d(d_first),
+                              props=jax.tree_util.tree_map(tile_d, d_props),
+                              step=step)
+                payload = program.message(gather_flat(st_full, fl_d_src), edges)
+                agg = combine_flat(payload, fl_d_dst, dm_flat)
             if program.direction in ("in", "both"):
-                dst_state = jax.tree_util.tree_map(
-                    lambda a: a[s_dst_g], st_full)
-                edges = Edges(src=s_src_l + v_off, dst=s_dst_g,
-                              mask=s_mask[kk], time=s_time,
-                              first_time=s_first, props=s_props, step=step)
-                payload = program.message(dst_state, edges)
-                agg_in = combine_tree(payload, s_src_l, n_loc,
-                                      program.combiner, s_mask[kk],
-                                      indices_are_sorted=True)
+                edges = Edges(src=tile_s(s_src_l) + v_off, dst=tile_s(s_dst_g),
+                              mask=sm_flat, time=tile_s(s_time),
+                              first_time=tile_s(s_first),
+                              props=jax.tree_util.tree_map(tile_s, s_props),
+                              step=step)
+                payload = program.message(gather_flat(st_full, fl_s_dst), edges)
+                agg_in = combine_flat(payload, fl_s_src, sm_flat)
                 agg = agg_in if agg is None else _merge_aggs(
                     program.combiner, agg, agg_in)
-            new_st, votes = program.update(st, agg, ctx)
-            # local vote only — the caller makes it global (psum over shards)
-            unhalted_local = jnp.sum((~(votes | ~v_mask[kk])).astype(jnp.int32))
-            return new_st, unhalted_local
 
-        vstep = jax.vmap(one_step, in_axes=(0, 0, None))
+            def upd_k(kk, stk, aggk):
+                new_st, votes = program.update(stk, aggk, mk_ctx(kk, step))
+                # local vote only — caller makes it global (psum over shards)
+                unhalted = jnp.sum((~(votes | ~v_mask[kk])).astype(jnp.int32))
+                return new_st, unhalted
+
+            return jax.vmap(upd_k, in_axes=(0, 0, 0))(
+                jnp.arange(k_loc), st, agg)
+
 
         if program.max_steps > 0:
             def cond(carry):
@@ -242,7 +278,7 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
 
             def body(carry):
                 step, st, halted = carry
-                new_st, unhalted_local = vstep(jnp.arange(k_loc), st, step)
+                new_st, unhalted_local = step_all(st, step)
                 # per-window GLOBAL quiescence: a window halts only when no
                 # shard changed state — freezing must never be shard-local,
                 # or a converged shard would stop receiving neighbours'
