@@ -39,6 +39,7 @@ class KafkaSource(Source):
                  group_id: str = "raphtory-tpu", name: str | None = None,
                  disorder: int = 0, max_records: int | None = None,
                  poll_timeout_s: float = 1.0, decode: str = "utf-8",
+                 follow: bool = False,
                  consumer_factory: Callable | None = None):
         self.topics = [topics] if isinstance(topics, str) else list(topics)
         self.bootstrap_servers = bootstrap_servers
@@ -48,6 +49,10 @@ class KafkaSource(Source):
         self.max_records = max_records
         self.poll_timeout_s = poll_timeout_s
         self.decode = decode
+        # follow=True keeps polling forever (GabKafkaSpout semantics): each
+        # consumer_timeout_ms expiry ends ONE poll round and the iterator is
+        # re-entered. follow=False bounds consumption to a single round.
+        self.follow = follow
         self._consumer_factory = consumer_factory
 
     def _make_consumer(self):
@@ -68,15 +73,30 @@ class KafkaSource(Source):
     def __iter__(self) -> Iterator[str]:
         consumer = self._make_consumer()
         emitted = 0
+        done = False
         try:
-            for record in consumer:
-                value = getattr(record, "value", record)
-                if isinstance(value, bytes):
-                    value = value.decode(self.decode)
-                yield value
-                emitted += 1
-                if self.max_records is not None and emitted >= self.max_records:
-                    break
+            while not done:
+                # one poll round: kafka-python's iterator raises StopIteration
+                # after consumer_timeout_ms idle; with follow=True we re-enter
+                # it (poll-forever), otherwise one round is the whole stream
+                round_start = emitted
+                for record in consumer:
+                    value = getattr(record, "value", record)
+                    if isinstance(value, bytes):
+                        value = value.decode(self.decode)
+                    yield value
+                    emitted += 1
+                    if (self.max_records is not None
+                            and emitted >= self.max_records):
+                        done = True
+                        break
+                else:
+                    done = not self.follow
+                    if not done and emitted == round_start:
+                        # pace empty rounds: a consumer whose iterator drains
+                        # without blocking (list-backed fakes, clients with no
+                        # poll timeout) must not busy-spin the re-enter loop
+                        _time.sleep(self.poll_timeout_s)
         finally:
             close = getattr(consumer, "close", None)
             if close is not None:
@@ -204,16 +224,19 @@ class HttpPollSource(Source):
 
     def __iter__(self) -> Iterator[str]:
         fetch = self._fetch or self._default_fetch
-        seen: set[str] = set()
+        prev: set[str] = set()  # previous poll's items only — bounded memory
         polls = 0
         while self.max_polls is None or polls < self.max_polls:
             if polls:
                 _time.sleep(self.poll_s)
             body = fetch(self.url)
             polls += 1
+            cur: set[str] = set()
             for item in self._splitter(body):
                 if self.dedup:
-                    if item in seen:
+                    dup = item in prev or item in cur
+                    cur.add(item)  # track even suppressed items: an item
+                    if dup:        # present in EVERY poll stays deduped
                         continue
-                    seen.add(item)
                 yield item
+            prev = cur

@@ -52,6 +52,24 @@ def test_kafka_source_max_records():
     assert list(src) == ["x", "y"]
 
 
+def test_kafka_source_follow_re_enters_poll_rounds():
+    """follow=True re-enters the consumer iterator after an idle round
+    (kafka-python ends iteration at consumer_timeout_ms) instead of
+    silently terminating; max_records bounds the stream."""
+    rounds = [[b"a"], [], [b"b", b"c"], [b"d"]]
+
+    class _RoundConsumer:
+        def __iter__(self):
+            return iter(rounds.pop(0) if rounds else [])
+
+        def close(self):
+            pass
+
+    src = KafkaSource("t", follow=True, max_records=3, poll_timeout_s=0.01,
+                      consumer_factory=lambda *a: _RoundConsumer())
+    assert list(src) == ["a", "b", "c"]
+
+
 def test_kafka_source_unavailable_without_client():
     with pytest.raises(SourceUnavailable, match="kafka-python"):
         list(KafkaSource("t"))
@@ -111,6 +129,20 @@ def test_http_poll_source_json_array_and_dedup():
     assert items == [{"id": 1}, {"id": 2}, {"id": 3}]  # dup dropped
 
 
+def test_http_poll_dedup_is_tail_bounded_but_stable():
+    """An item present in EVERY poll stays deduped (no every-other-poll
+    re-emit), while an item that ages out of the tail re-emits on return."""
+    bodies = iter([
+        json.dumps([{"id": 1}, {"id": 9}]),
+        json.dumps([{"id": 2}, {"id": 9}]),   # 9 persists -> deduped
+        json.dumps([{"id": 1}, {"id": 9}]),   # 1 aged out -> re-emitted
+    ])
+    src = HttpPollSource("http://x/feed", max_polls=3, poll_s=0.0,
+                         fetch=lambda url: next(bodies))
+    ids = [json.loads(i)["id"] for i in src]
+    assert ids == [1, 9, 2, 1]
+
+
 def test_http_poll_source_lines():
     src = HttpPollSource("http://x", max_polls=1,
                          fetch=lambda url: "a,b\nc,d\n\n")
@@ -123,7 +155,7 @@ def test_kafka_source_through_pipeline():
     from raphtory_tpu.ingestion.parser import IntCsvEdgeListParser
     from raphtory_tpu.ingestion.pipeline import IngestionPipeline
 
-    lines = [f"{t},{t % 5},{(t + 1) % 5}".encode() for t in range(1, 30)]
+    lines = [f"{t % 5},{(t + 1) % 5},{t}".encode() for t in range(1, 30)]
     src = KafkaSource(
         "edges", consumer_factory=lambda *a: _FakeConsumer(lines))
     g = TemporalGraph()
