@@ -24,6 +24,7 @@ hop i+1's snapshot folds on host while hop i's supersteps run on device.
 """
 
 import argparse
+import functools
 import json
 import sys
 import time as _time
@@ -126,13 +127,23 @@ def _range_sweep(programs, log, view_times, windows):
 # ---------------------------------------------------------------- configs
 
 
+_GAB_SPAN = 2_600_000
+
+
+@functools.lru_cache(maxsize=1)
+def _gab_log():
+    """One GAB-scale log shared by the three GAB suite configs."""
+    from raphtory_tpu.utils.synth import gab_like_log
+
+    return gab_like_log(n_vertices=30_000, n_edges=300_000, t_span=_GAB_SPAN)
+
+
 def bench_headline():
     """North star: windowed PageRank Range query, GAB-scale graph."""
     from raphtory_tpu.algorithms import PageRank
-    from raphtory_tpu.utils.synth import gab_like_log
 
-    t_span = 2_600_000
-    log = gab_like_log(n_vertices=30_000, n_edges=300_000, t_span=t_span)
+    t_span = _GAB_SPAN
+    log = _gab_log()
     view_times = np.linspace(0.45 * t_span, t_span, 12).astype(np.int64)
     vps, detail = _range_sweep(
         PageRank(max_steps=20, tol=1e-7), log, view_times,
@@ -152,10 +163,9 @@ def bench_gab_cc_range():
     """The actual README datapoint shape: ConnectedComponents Range query
     over the GAB graph, one 1-month window per view (viewTime 12,056 ms)."""
     from raphtory_tpu.algorithms import ConnectedComponents
-    from raphtory_tpu.utils.synth import gab_like_log
 
-    t_span = 2_600_000
-    log = gab_like_log(n_vertices=30_000, n_edges=300_000, t_span=t_span)
+    t_span = _GAB_SPAN
+    log = _gab_log()
     view_times = np.linspace(0.45 * t_span, t_span, 12).astype(np.int64)
     vps, detail = _range_sweep(
         ConnectedComponents(max_steps=50), log, view_times, [2_600_000])
@@ -176,10 +186,9 @@ def bench_gab_pr_view():
     from raphtory_tpu.algorithms import PageRank
     from raphtory_tpu.core.snapshot import build_view
     from raphtory_tpu.engine import bsp
-    from raphtory_tpu.utils.synth import gab_like_log
 
-    t_span = 2_600_000
-    log = gab_like_log(n_vertices=30_000, n_edges=300_000, t_span=t_span)
+    t_span = _GAB_SPAN
+    log = _gab_log()
     program = PageRank(max_steps=20, tol=1e-7)
     view = build_view(log, t_span)
     bsp.run(program, view, window=2_600_000)  # compile warmup

@@ -39,10 +39,19 @@ class TaintTracking(VertexProgram):
     start_time: int = 0
     stop_list: tuple = ()        # absorb but never re-emit (exchange stop)
     max_steps: int = 50
+    value_prop: str | None = None  # per-occurrence value gate (see below)
+    min_value: float = 0.0
     combiner = "min"
     direction = "out"
     needs_occurrences = True
     needs_vertex_times = False
+
+    @property
+    def edge_props(self):  # type: ignore[override]
+        """Value-weighted taint: with ``value_prop`` set, an occurrence only
+        carries taint when its OWN event property (e.g. the transferred
+        amount) is >= ``min_value`` — dust transactions don't propagate."""
+        return (self.value_prop,) if self.value_prop else ()
 
     def init(self, ctx: Context):
         tainted = _member(ctx.vids, self.seeds) & ctx.v_mask
@@ -54,6 +63,9 @@ class TaintTracking(VertexProgram):
         # edge.time is the occurrence (transaction) time; taint flows only
         # forward in time, and never OUT of a stop-listed vertex
         can_emit = (src_state["taint"] <= edge.time) & ~src_state["stopped"]
+        if self.value_prop:
+            val = edge.props[self.value_prop]
+            can_emit &= ~jnp.isnan(val) & (val >= self.min_value)
         return jnp.where(can_emit, edge.time, IMAX)
 
     def update(self, state, agg, ctx: Context):

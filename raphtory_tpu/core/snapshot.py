@@ -121,6 +121,7 @@ class GraphView:
     occ_dst: np.ndarray | None = None
     occ_time: np.ndarray | None = None  # i64[o_pad]
     occ_mask: np.ndarray | None = None
+    _occ_rows: np.ndarray | None = field(default=None, repr=False)  # i64[o_pad] log rows, -1 pad
     _log: EventLog | None = field(default=None, repr=False)
     _eadd_rows: np.ndarray | None = field(default=None, repr=False)
     _vadd_rows: np.ndarray | None = field(default=None, repr=False)
@@ -192,6 +193,33 @@ class GraphView:
             keys=(log.column("src")[rows], log.column("dst")[rows]),
             lookup_keys=(gsrc, gdst), default=default, strings=True,
         )
+
+    def occ_prop(self, name: str, default: float = np.nan) -> np.ndarray:
+        """f64[o_pad]: the property value attached to each occurrence's OWN
+        edge-add event (per-transaction values — e.g. transferred amount for
+        value-weighted taint) — unlike ``edge_prop``, which folds to the
+        latest value per deduplicated edge."""
+        rows = self._occ_rows
+        if rows is None:
+            raise ValueError("view was built without include_occurrences")
+        out = np.full(len(rows), default, np.float64)
+        log = self._log
+        if log is None or name not in log.props._key_ids:
+            return out
+        kid = log.props._key_ids[name]
+        pk = log.props.column("key")
+        sel = (pk == kid) & (log.props.column("tag") == log.props.NUM_TAG)
+        if not sel.any():
+            return out
+        ev = log.props.column("event")[sel]
+        val = log.props.column("num")[sel]
+        order = np.argsort(ev, kind="stable")  # last write per event wins
+        ev, val = ev[order], val[order]
+        pos = np.searchsorted(ev, rows, side="right") - 1
+        ok = (pos >= 0) & (rows >= 0)
+        ok &= ev[np.clip(pos, 0, None)] == rows
+        out[ok] = val[pos[ok]]
+        return out
 
     def local_index(self, global_ids) -> np.ndarray:
         """Map global vertex ids → local indices (-1 if absent/padded)."""
@@ -548,10 +576,13 @@ def _attach_occurrences(view: GraphView, ea_rows, ea_t, ea_s, ea_d) -> None:
     occ_dst = np.full(o_pad, view.n_pad - 1, np.int32)
     occ_time = np.full(o_pad, INT64_MIN, np.int64)
     occ_mask = np.zeros(o_pad, bool)
+    occ_rows = np.full(o_pad, -1, np.int64)
     order = np.lexsort((sl[idx], dl[idx]))
     occ_src[:o] = sl[idx][order]
     occ_dst[:o] = dl[idx][order]
     occ_time[:o] = ea_t[idx][order]
     occ_mask[:o] = True
+    occ_rows[:o] = np.asarray(ea_rows)[idx][order]
     view.occ_src, view.occ_dst = occ_src, occ_dst
     view.occ_time, view.occ_mask = occ_time, occ_mask
+    view._occ_rows = occ_rows

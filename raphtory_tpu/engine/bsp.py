@@ -187,7 +187,12 @@ def _compiled_runner(program: VertexProgram, n: int, m: int, k: int,
 def _gather_props(view: GraphView, keys, kind: str):
     out = {}
     for name in keys:
-        arr = view.edge_prop(name) if kind == "e" else view.vertex_prop(name)
+        if kind == "occ":
+            arr = view.occ_prop(name)  # per-occurrence (per-event) values
+        elif kind == "e":
+            arr = view.edge_prop(name)
+        else:
+            arr = view.vertex_prop(name)
         out[name] = jnp.asarray(arr, jnp.float32)
     return out
 
@@ -250,10 +255,9 @@ def run_async(
         program, view.n_pad, m_pad, k,
         tuple(program.edge_props), tuple(program.vertex_props),
     )
-    if program.needs_occurrences and program.edge_props:
-        raise NotImplementedError(
-            "edge_props on occurrence programs not yet supported")
-    eprops = _gather_props(view, program.edge_props, "e")
+    eprops = _gather_props(
+        view, program.edge_props,
+        "occ" if program.needs_occurrences else "e")
     vprops = _gather_props(view, program.vertex_props, "v")
     win_arr = jnp.asarray([(-1 if w is None else int(w)) for w in wlist], jnp.int64)
 

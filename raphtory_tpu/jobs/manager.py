@@ -187,10 +187,7 @@ class Job:
             self._emit(t, q.window, result, view, steps, t0)
 
     def _execute(self, view, window=None, windows=None):
-        # Occurrence-based programs need the raw edge-event stream, which the
-        # sharded view does not partition — they run single-device rather
-        # than silently dropping per-occurrence history on the mesh.
-        if self.mesh is not None and not self.program.needs_occurrences:
+        if self.mesh is not None:
             from ..parallel import sharded
 
             return sharded.run(self.program, view, self.mesh,

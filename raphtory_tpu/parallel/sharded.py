@@ -11,18 +11,26 @@ managers + point-to-point actor messages + ack counting
   combine-at-destination) and by SRC shard (for in-direction) — the analogue
   of the reference's src-copy + ``SplitEdge`` dst-mirror, but immutable, so
   the entire ack/sync dance disappears.
-* A superstep all_gathers the (small) per-vertex state along the vertex axis
-  over ICI, gathers source states locally, segment-combines into the local
-  slice. Votes/quiescence are a ``psum`` — the reference's coordinator
-  counting EndStep acks collapses into one collective (SURVEY §2.9).
+* A superstep moves remote neighbour state over ICI by one of two routes,
+  chosen per (graph, mesh) by measured exchange volume (``comm="auto"``):
+  - **all_gather**: replicate the (small) per-vertex state along the vertex
+    axis — best when most shards reference most of the graph (dense or tiny
+    graphs, few shards).
+  - **halo exchange**: at partition time each shard records exactly which
+    REMOTE vertices its edges reference (the halo — the immutable analogue
+    of the reference's ``SplitEdge`` dst-mirrors); each superstep exchanges
+    only those rows via one ``all_to_all`` over ICI. O(halo) instead of
+    O(|V|) bytes — the SURVEY §2.9 row-4 translation (point-to-point vertex
+    messages → collective exchange of referenced remote state).
+* Votes/quiescence are a ``psum`` — the reference's coordinator counting
+  EndStep acks collapses into one collective (SURVEY §2.9).
 * Batched windows ride a second mesh axis (``windows``) — window sweeps are
   embarrassingly parallel, so multi-chip scaling multiplies window throughput
   (the reference's analogue of sequence parallelism, SURVEY §5.7).
-
-Scaling note (How-to-Scale-Your-Model recipe): all_gather of state costs
-|V|·state_bytes per superstep over ICI. For bigger-than-ICI graphs the next
-step is halo compaction (ppermute only the remote sources each shard actually
-references); the partition layout here is already built for it.
+* Occurrence (temporal multigraph) programs — TaintTracking et al.
+  (``EthereumTaintTracking.scala:93-127``) — shard exactly like deduplicated
+  edges: the per-event ``occ_*`` arrays are scattered into dst-/src-
+  partitioned blocks with per-occurrence times and props.
 """
 
 from __future__ import annotations
@@ -85,16 +93,87 @@ class ShardedView:
     d_props: dict              # name -> f32[S, m_loc_d]
     s_props: dict
     view: GraphView
+    occurrences: bool = False  # blocks hold occ_* (multigraph) rows
+    # halo structures (one per partition direction): h_* is the per-
+    # (requester, owner) slot capacity; *_h remaps the global ref array into
+    # [local | halo] space [0, n_loc + S*h); *_send[S, S*h] is each owner
+    # device's all_to_all send page (local rows grouped by requester).
+    h_d: int = 0
+    d_src_h: np.ndarray | None = None   # i32[S, m_loc_d]
+    d_send: np.ndarray | None = None    # i32[S, S*h_d]
+    h_s: int = 0
+    s_dst_h: np.ndarray | None = None
+    s_send: np.ndarray | None = None
+
+    def halo_rows(self, direction: str) -> int:
+        """Rows exchanged per device per superstep on the halo path (vs
+        ``view.n_pad - n_loc`` received per device for all_gather)."""
+        rows = 0
+        if direction in ("out", "both"):
+            rows += self.n_shards * self.h_d
+        if direction in ("in", "both"):
+            rows += self.n_shards * self.h_s
+        return rows
 
 
 def _pow2(n: int) -> int:
     return 8 if n <= 8 else 1 << int(np.ceil(np.log2(n)))
 
 
+def _build_halo(idx_g: np.ndarray, n_loc: int, S: int):
+    """Halo layout for one partition direction.
+
+    ``idx_g[S, m_loc]`` holds GLOBAL vertex refs per shard. Returns
+    ``(h, idx_h, send)``: per-(requester, owner) slot capacity ``h``;
+    ``idx_h[S, m_loc]`` remapping each ref into the shard's extended space —
+    local row for own vertices, ``n_loc + owner*h + slot`` for remote ones;
+    ``send[S, S*h]`` where row ``o`` is owner-device o's all_to_all send
+    page: chunk ``r`` lists the local rows requester ``r`` referenced
+    (sorted unique; slot order matches the requester's remap)."""
+    idx_h = np.zeros(idx_g.shape, np.int32)
+    uniq = []  # (requester, u_owner[], u_g[], slot[])
+    maxcnt = 1
+    for sh in range(S):
+        g = idx_g[sh].astype(np.int64)
+        owner = g // n_loc
+        local = owner == sh
+        idx_h[sh, local] = (g[local] - sh * n_loc).astype(np.int32)
+        rem = np.flatnonzero(~local)
+        if len(rem) == 0:
+            continue
+        go, oo = g[rem], owner[rem]
+        order = np.lexsort((go, oo))
+        gs, os_ = go[order], oo[order]
+        new = np.ones(len(gs), bool)
+        new[1:] = (gs[1:] != gs[:-1]) | (os_[1:] != os_[:-1])
+        uid = np.cumsum(new) - 1                      # unique rank per row
+        u_owner = os_[new]
+        u_g = gs[new]
+        # slot within owner group = unique rank − rank at owner's first unique
+        o_change = np.ones(len(u_owner), bool)
+        o_change[1:] = u_owner[1:] != u_owner[:-1]
+        arange_u = np.arange(len(u_g))
+        base = np.maximum.accumulate(np.where(o_change, arange_u, 0))
+        slot = (arange_u - base).astype(np.int64)
+        maxcnt = max(maxcnt, int(slot.max()) + 1)
+        # remote-row remap happens in the second pass (slots need final h)
+        uniq.append((sh, u_owner, u_g, slot, rem[order], uid))
+    h = _pow2(maxcnt)
+    send = np.zeros((S, S * h), np.int32)
+    for sh, u_owner, u_g, slot, rows, uid in uniq:
+        idx_h[sh, rows] = (n_loc + u_owner[uid] * h + slot[uid]).astype(np.int32)
+        send[u_owner, sh * h + slot] = (u_g - u_owner * n_loc).astype(np.int32)
+    return h, idx_h, send
+
+
 def partition_view(view: GraphView, n_shards: int,
-                   edge_props: tuple = ()) -> ShardedView:
+                   edge_props: tuple = (),
+                   occurrences: bool = False) -> ShardedView:
     """Range-partition the padded vertex space into contiguous shards and
-    scatter edges into per-shard blocks (dst- and src-partitioned)."""
+    scatter edges into per-shard blocks (dst- and src-partitioned), plus the
+    halo exchange layout. With ``occurrences=True`` the blocks hold the
+    multigraph occurrence rows (per-event times/props) instead of the
+    deduplicated edges."""
     assert view.n_pad % n_shards == 0, (
         f"vertex shard count {n_shards} must divide the padded vertex count "
         f"{view.n_pad} (pad buckets are powers of two; use a power-of-two "
@@ -102,12 +181,23 @@ def partition_view(view: GraphView, n_shards: int,
     n_loc = view.n_pad // n_shards
     S = n_shards
 
-    act = view.e_mask
-    esrc = view.e_src[act].astype(np.int64)
-    edst = view.e_dst[act].astype(np.int64)
-    etime = view.e_latest_time[act]
-    efirst = view.e_first_time[act]
-    props = {k: view.edge_prop(k)[act] for k in edge_props}
+    if occurrences:
+        if view.occ_src is None:
+            raise ValueError("program needs occurrences: build the view "
+                             "with include_occurrences=True")
+        act = view.occ_mask
+        esrc = view.occ_src[act].astype(np.int64)
+        edst = view.occ_dst[act].astype(np.int64)
+        etime = view.occ_time[act]
+        efirst = view.occ_time[act]
+        props = {k: view.occ_prop(k)[act] for k in edge_props}
+    else:
+        act = view.e_mask
+        esrc = view.e_src[act].astype(np.int64)
+        edst = view.e_dst[act].astype(np.int64)
+        etime = view.e_latest_time[act]
+        efirst = view.e_first_time[act]
+        props = {k: view.edge_prop(k)[act] for k in edge_props}
 
     def _partition(owner_of, local_of, global_of):
         owner = owner_of // n_loc
@@ -139,6 +229,9 @@ def partition_view(view: GraphView, n_shards: int,
     m_loc_s, s_dst_g, s_src_l, s_mask, s_time, s_first, s_props = _partition(
         esrc, esrc % n_loc, edst)
 
+    h_d, d_src_h, d_send = _build_halo(d_src_g, n_loc, S)
+    h_s, s_dst_h, s_send = _build_halo(s_dst_g, n_loc, S)
+
     rs = lambda a: a.reshape(S, n_loc)
     return ShardedView(
         n_shards=S, n_loc=n_loc, m_loc_d=m_loc_d, m_loc_s=m_loc_s,
@@ -149,16 +242,20 @@ def partition_view(view: GraphView, n_shards: int,
         s_dst_g=s_dst_g, s_src_l=s_src_l, s_mask=s_mask,
         s_time=s_time, s_first=s_first,
         d_props=d_props, s_props=s_props, view=view,
+        occurrences=occurrences,
+        h_d=h_d, d_src_h=d_src_h, d_send=d_send,
+        h_s=h_s, s_dst_h=s_dst_h, s_send=s_send,
     )
 
 
 @functools.lru_cache(maxsize=128)
 def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
                     m_loc_d: int, m_loc_s: int, k_loc: int, n_pad: int,
-                    prop_keys: tuple):
-    """Compile one SPMD program for (algorithm, shapes, mesh)."""
-    has_w = W_AXIS in mesh.axis_names and mesh.shape[W_AXIS] > 1
+                    prop_keys: tuple, comm: str = "all_gather",
+                    h_d: int = 0, h_s: int = 0):
+    """Compile one SPMD program for (algorithm, shapes, mesh, comm route)."""
     reduce_axes = (W_AXIS, V_AXIS)
+    S_v = mesh.shape[V_AXIS]
 
     def gather_state(state_loc):
         # state leaves are [k_loc, n_loc, ...]: the vertex axis is axis 1
@@ -168,10 +265,23 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
             lambda a: jax.lax.all_gather(a, V_AXIS, axis=1, tiled=True),
             state_loc)
 
+    def exchange_halo(state_loc, send_idx):
+        # halo route: each device ships ONLY the rows its peers reference.
+        # send_idx i32[S*h]: chunk r = local rows requester r wants; one
+        # tiled all_to_all swaps chunks so chunk o of the result is what
+        # owner o sent us — laid out to match the *_h remaps. Result leaves
+        # are the extended space [k_loc, n_loc + S*h, ...] (own rows first).
+        def leaf(a):
+            send = jnp.take(a, send_idx, axis=1)
+            recv = jax.lax.all_to_all(
+                send, V_AXIS, split_axis=1, concat_axis=1, tiled=True)
+            return jnp.concatenate([a, recv], axis=1)
+        return jax.tree_util.tree_map(leaf, state_loc)
+
     def device_fn(v_mask, vids, v_latest, v_first,
                   d_src_g, d_dst_l, d_mask, d_time, d_first,
                   s_dst_g, s_src_l, s_mask, s_time, s_first,
-                  d_props, s_props, vprops, time, windows):
+                  halo, d_props, s_props, vprops, time, windows):
         # shapes (per device): v_mask [Kl, n_loc]; d_* [m_loc_d] / masks
         # [Kl, m_loc_d]; windows [Kl]
         v_off = jax.lax.axis_index(V_AXIS).astype(jnp.int32) * n_loc
@@ -183,11 +293,20 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
         # the TPU backend when the loop condition reads carried state
         # (see engine/bsp.py make_runner).
         woffs_loc = (jnp.arange(k_loc, dtype=jnp.int32) * n_loc)[:, None]
-        woffs_pad = (jnp.arange(k_loc, dtype=jnp.int32) * n_pad)[:, None]
         fl_d_dst = (d_dst_l[None, :] + woffs_loc).reshape(-1)  # sorted/blk
-        fl_d_src = (d_src_g[None, :] + woffs_pad).reshape(-1)  # into st_full
         fl_s_src = (s_src_l[None, :] + woffs_loc).reshape(-1)  # sorted/blk
-        fl_s_dst = (s_dst_g[None, :] + woffs_pad).reshape(-1)
+        if comm == "halo":
+            # gather indices live in each shard's [local | halo] space
+            ext_d = n_loc + S_v * h_d
+            ext_s = n_loc + S_v * h_s
+            woffs_d = (jnp.arange(k_loc, dtype=jnp.int32) * ext_d)[:, None]
+            woffs_s = (jnp.arange(k_loc, dtype=jnp.int32) * ext_s)[:, None]
+            fl_d_src = (halo["d_src_h"][None, :] + woffs_d).reshape(-1)
+            fl_s_dst = (halo["s_dst_h"][None, :] + woffs_s).reshape(-1)
+        else:
+            woffs_pad = (jnp.arange(k_loc, dtype=jnp.int32) * n_pad)[:, None]
+            fl_d_src = (d_src_g[None, :] + woffs_pad).reshape(-1)
+            fl_s_dst = (s_dst_g[None, :] + woffs_pad).reshape(-1)
         dm_flat = d_mask.reshape(-1)
         sm_flat = s_mask.reshape(-1)
 
@@ -228,13 +347,20 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
 
         state0 = jax.vmap(init_k)(jnp.arange(k_loc))
 
-        def gather_flat(st_full, ids):
+        def gather_flat(st_pool, ids, width):
             return jax.tree_util.tree_map(
-                lambda a: a.reshape((k_loc * n_pad,) + a.shape[2:])[ids],
-                st_full)
+                lambda a: a.reshape((k_loc * width,) + a.shape[2:])[ids],
+                st_pool)
 
         def step_all(st, step):
-            st_full = gather_state(st)  # [k_loc, n_pad, ...]
+            if comm == "halo":
+                pool_d = lambda: exchange_halo(st, halo["d_send"])
+                pool_s = lambda: exchange_halo(st, halo["s_send"])
+                width_d, width_s = n_loc + S_v * h_d, n_loc + S_v * h_s
+            else:
+                st_full = gather_state(st)  # [k_loc, n_pad, ...]
+                pool_d = pool_s = lambda: st_full
+                width_d = width_s = n_pad
             agg = None
             if program.direction in ("out", "both"):
                 # Edges contract: src/dst are GLOBAL padded indices
@@ -243,7 +369,8 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
                               first_time=tile_d(d_first),
                               props=jax.tree_util.tree_map(tile_d, d_props),
                               step=step)
-                payload = program.message(gather_flat(st_full, fl_d_src), edges)
+                payload = program.message(
+                    gather_flat(pool_d(), fl_d_src, width_d), edges)
                 agg = combine_flat(payload, fl_d_dst, dm_flat)
             if program.direction in ("in", "both"):
                 edges = Edges(src=tile_s(s_src_l) + v_off, dst=tile_s(s_dst_g),
@@ -251,7 +378,8 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
                               first_time=tile_s(s_first),
                               props=jax.tree_util.tree_map(tile_s, s_props),
                               step=step)
-                payload = program.message(gather_flat(st_full, fl_s_dst), edges)
+                payload = program.message(
+                    gather_flat(pool_s(), fl_s_dst, width_s), edges)
                 agg_in = combine_flat(payload, fl_s_src, sm_flat)
                 agg = agg_in if agg is None else _merge_aggs(
                     program.combiner, agg, agg_in)
@@ -312,6 +440,7 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
         v, v, v,       # vids, v_latest, v_first [S, n_loc]
         v, v, kv, v, v,        # d_src_g, d_dst_l, d_mask[K,S,m], d_time, d_first
         v, v, kv, v, v,        # s_dst_g, s_src_l, s_mask, s_time, s_first
+        v,             # halo dict (leaves [S, m_loc] / [S, S*h])
         v, v, v,       # edge/vertex prop dicts (leaves [S, m_loc] / [S, n_loc])
         P(),           # time scalar
         P(W_AXIS),     # windows [K]
@@ -321,7 +450,7 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
     def squeeze_fn(v_mask, vids, v_latest, v_first,
                    d_src_g, d_dst_l, d_mask, d_time, d_first,
                    s_dst_g, s_src_l, s_mask, s_time, s_first,
-                   d_props, s_props, vprops, time, windows):
+                   halo, d_props, s_props, vprops, time, windows):
         # strip the sharded block axes: [Kl, 1, ...] -> [Kl, ...]; [1, ...] -> [...]
         sq_kv = lambda a: a.reshape((a.shape[0],) + a.shape[2:])
         sq_v = lambda a: a.reshape(a.shape[1:])
@@ -329,6 +458,7 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
             sq_kv(v_mask), sq_v(vids), sq_v(v_latest), sq_v(v_first),
             sq_v(d_src_g), sq_v(d_dst_l), sq_kv(d_mask), sq_v(d_time), sq_v(d_first),
             sq_v(s_dst_g), sq_v(s_src_l), sq_kv(s_mask), sq_v(s_time), sq_v(s_first),
+            jax.tree_util.tree_map(sq_v, halo),
             jax.tree_util.tree_map(sq_v, d_props),
             jax.tree_util.tree_map(sq_v, s_props),
             jax.tree_util.tree_map(sq_v, vprops),
@@ -345,16 +475,18 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
 
 def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
         window: int | None = None, windows=None,
-        sharded_view: ShardedView | None = None):
+        sharded_view: ShardedView | None = None, comm: str = "auto"):
     """Run a vertex program SPMD over the mesh. Same surface as
     ``engine.bsp.run`` plus the mesh. Returns (result, steps) with result
-    leading axes [K windows, n_pad] in GLOBAL vertex order."""
+    leading axes [K windows, n_pad] in GLOBAL vertex order.
+
+    ``comm`` picks the cross-shard state route: ``"all_gather"`` replicates
+    the state along the vertex axis each superstep, ``"halo"`` exchanges only
+    the remote rows each shard's edges reference (one all_to_all), and
+    ``"auto"`` (default) picks halo whenever its measured exchange volume is
+    smaller."""
     batched = windows is not None
-    if getattr(program, "needs_occurrences", False):
-        raise NotImplementedError(
-            "occurrence-based programs (temporal multigraph traversal, e.g. "
-            "TaintTracking) are not supported on a mesh yet — the sharded "
-            "view partitions deduplicated edges only; run via engine.bsp")
+    occurrences = bool(getattr(program, "needs_occurrences", False))
     if windows is not None and len(windows) == 0:
         raise ValueError("windows must be a non-empty list of window sizes")
     if windows is None:
@@ -372,8 +504,20 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
 
     sv = sharded_view
     if (sv is None or sv.n_shards != S or sv.view is not view
+            or sv.occurrences != occurrences
             or not set(program.edge_props) <= set(sv.d_props)):
-        sv = partition_view(view, S, tuple(program.edge_props))
+        sv = partition_view(view, S, tuple(program.edge_props),
+                            occurrences=occurrences)
+
+    if comm not in ("auto", "halo", "all_gather"):
+        raise ValueError(f"comm must be auto|halo|all_gather, got {comm!r}")
+    if comm == "auto":
+        # halo wins when the referenced remote rows are fewer than the
+        # remote rows all_gather would replicate (n_pad - n_loc per device);
+        # ties go to all_gather, whose single collective schedules better
+        comm = ("halo" if S > 1
+                and sv.halo_rows(program.direction) < view.n_pad - sv.n_loc
+                else "all_gather")
 
     # window masks, computed from per-shard latest-time arrays
     v_masks = np.empty((k_pad, S, sv.n_loc), bool)
@@ -390,9 +534,20 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
             d_masks[i] = sv.d_mask & (sv.d_time >= lo)
             s_masks[i] = sv.s_mask & (sv.s_time >= lo)
 
+    # h_* only shape the compiled program on the halo route — keep them out
+    # of the runner cache key otherwise, or same-bucket sweep hops with
+    # different halo populations would recompile for nothing
     runner = _sharded_runner(
         program, mesh, sv.n_loc, sv.m_loc_d, sv.m_loc_s, k_loc, view.n_pad,
-        tuple(program.edge_props))
+        tuple(program.edge_props), comm,
+        sv.h_d if comm == "halo" else 0, sv.h_s if comm == "halo" else 0)
+
+    halo = {}
+    if comm == "halo":
+        halo = {"d_src_h": jnp.asarray(sv.d_src_h),
+                "d_send": jnp.asarray(sv.d_send),
+                "s_dst_h": jnp.asarray(sv.s_dst_h),
+                "s_send": jnp.asarray(sv.s_send)}
 
     result, steps = runner(
         jnp.asarray(v_masks), jnp.asarray(sv.vids), jnp.asarray(sv.v_latest),
@@ -401,6 +556,7 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
         jnp.asarray(sv.d_time), jnp.asarray(sv.d_first),
         jnp.asarray(sv.s_dst_g), jnp.asarray(sv.s_src_l), jnp.asarray(s_masks),
         jnp.asarray(sv.s_time), jnp.asarray(sv.s_first),
+        halo,
         {kk: jnp.asarray(vv) for kk, vv in sv.d_props.items()},
         {kk: jnp.asarray(vv) for kk, vv in sv.s_props.items()},
         {kk: jnp.asarray(

@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from raphtory_tpu import EventLog, build_view
-from raphtory_tpu.algorithms import ConnectedComponents, PageRank
+from raphtory_tpu.algorithms import ConnectedComponents, PageRank, TaintTracking
 from raphtory_tpu.engine import bsp
 from raphtory_tpu.parallel import sharded
 
@@ -110,3 +110,127 @@ def test_single_device_mesh_degenerate(eight_devices):
     got, _ = sharded.run(ConnectedComponents(), view, mesh)
     want, _ = bsp.run(ConnectedComponents(), view)
     assert _cc_partition(got, view.v_mask) == _cc_partition(want, view.v_mask)
+
+
+# ---------------------------------------------------------------- halo route
+
+
+@pytest.mark.parametrize("comm", ["halo", "all_gather"])
+def test_cc_both_comm_routes_match_single(comm, eight_devices):
+    """The same program over both state routes == single-device result.
+    CC is direction='both', so the halo route exercises BOTH partition
+    directions' exchanges."""
+    log = _random_log(7)
+    view = build_view(log, 90)
+    mesh = sharded.make_mesh(8, 1, devices=eight_devices)
+    got, _ = sharded.run(ConnectedComponents(), view, mesh, comm=comm)
+    want, _ = bsp.run(ConnectedComponents(), view)
+    assert _cc_partition(got, view.v_mask) == _cc_partition(want, view.v_mask)
+
+
+@pytest.mark.parametrize("comm", ["halo", "all_gather"])
+def test_pagerank_windowed_halo_matches_single(comm, eight_devices):
+    log = _random_log(8)
+    view = build_view(log, 95)
+    mesh = sharded.make_mesh(4, 2, devices=eight_devices)
+    prog = PageRank(max_steps=30, tol=0.0)
+    windows = [200, 40, 10]
+    got, _ = sharded.run(prog, view, mesh, windows=windows, comm=comm)
+    want, _ = bsp.run(prog, view, windows=windows)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_halo_volume_smaller_than_all_gather_on_sparse_graph(eight_devices):
+    """On a sparse graph each shard references few remote vertices, so the
+    halo exchange moves fewer rows than the full-state all_gather — and
+    comm='auto' must therefore pick the halo route."""
+    rng = np.random.default_rng(0)
+    log = EventLog()
+    n = 4096
+    for i in range(n):  # ring + a few chords: ~2 edges per vertex
+        log.add_edge(int(rng.integers(0, 50)), i, (i + 1) % n)
+    for _ in range(256):
+        a, b = (int(x) for x in rng.integers(0, n, 2))
+        log.add_edge(int(rng.integers(0, 50)), a, b)
+    view = build_view(log, 100)
+    sv = sharded.partition_view(view, 8)
+    assert sv.halo_rows("out") < view.n_pad
+    assert sv.halo_rows("both") < view.n_pad
+    # equivalence on the route auto picks (halo)
+    mesh = sharded.make_mesh(8, 1, devices=eight_devices)
+    prog = PageRank(max_steps=5, tol=0.0)
+    got, _ = sharded.run(prog, view, mesh, sharded_view=sv, comm="auto")
+    want, _ = bsp.run(prog, view)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+# ------------------------------------------------------- occurrence programs
+
+
+def _taint_log():
+    """Multigraph with repeated edges at different times + deletes."""
+    rng = np.random.default_rng(42)
+    log = EventLog()
+    for _ in range(600):
+        t = int(rng.integers(0, 100))
+        a, b = (int(x) for x in rng.integers(0, 40, 2))
+        r = rng.random()
+        if r < 0.8:
+            log.add_edge(t, a, b, props={"value": float(rng.integers(1, 10))})
+        elif r < 0.9:
+            log.delete_edge(t, a, b)
+        else:
+            log.delete_vertex(t, a)
+    return log
+
+
+@pytest.mark.parametrize("comm", ["auto", "halo", "all_gather"])
+def test_taint_occurrence_program_on_mesh(comm, eight_devices):
+    """TaintTracking (occurrence/multigraph program) sharded == single-device
+    (EthereumTaintTracking.scala:93-127 parity on the mesh)."""
+    log = _taint_log()
+    view = build_view(log, 95, include_occurrences=True)
+    seeds = tuple(int(v) for v in view.vids[:3] if v >= 0)
+    prog = TaintTracking(seeds=seeds, start_time=5, max_steps=30)
+    mesh = sharded.make_mesh(8, 1, devices=eight_devices)
+    got, _ = sharded.run(prog, view, mesh, comm=comm)
+    want, _ = bsp.run(prog, view)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_taint_windowed_on_mesh_matches_single(eight_devices):
+    log = _taint_log()
+    view = build_view(log, 95, include_occurrences=True)
+    seeds = tuple(int(v) for v in view.vids[:2] if v >= 0)
+    prog = TaintTracking(seeds=seeds, start_time=0, max_steps=30)
+    mesh = sharded.make_mesh(4, 2, devices=eight_devices)
+    windows = [200, 30]
+    got, _ = sharded.run(prog, view, mesh, windows=windows)
+    want, _ = bsp.run(prog, view, windows=windows)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_value_weighted_taint_on_mesh_and_single(eight_devices):
+    """edge_props on occurrence programs: taint gated on each occurrence's
+    OWN transaction value, sharded == single == value-respecting."""
+    log = EventLog()
+    # chain 1 -t1-> 2 -t2-> 3 with a dust hop; big parallel hop later
+    log.add_edge(10, 1, 2, props={"value": 100.0})
+    log.add_edge(20, 2, 3, props={"value": 0.5})    # dust: blocks taint
+    log.add_edge(30, 2, 3, props={"value": 50.0})   # real: carries taint
+    log.add_edge(5, 3, 4, props={"value": 99.0})    # too early for taint
+    log.add_edge(40, 3, 4, props={"value": 99.0})
+    view = build_view(log, 50, include_occurrences=True)
+    prog = TaintTracking(seeds=(1,), start_time=0, max_steps=10,
+                         value_prop="value", min_value=1.0)
+    want, _ = bsp.run(prog, view)
+    taint = {int(view.vids[i]): int(np.asarray(want)[i])
+             for i in range(view.n_active)}
+    IMAX = np.iinfo(np.int64).max
+    assert taint[1] == 0 and taint[2] == 10
+    assert taint[3] == 30  # NOT 20: the dust hop must not carry taint
+    assert taint[4] == 40  # NOT 5: time-respecting propagation
+    mesh = sharded.make_mesh(8, 1, devices=eight_devices)
+    for comm in ("halo", "all_gather"):
+        got, _ = sharded.run(prog, view, mesh, comm=comm)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
