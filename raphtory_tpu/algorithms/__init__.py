@@ -8,6 +8,7 @@ from .connected_components import ConnectedComponents
 from .degree import DegreeBasic
 from .diffusion import BinaryDiffusion
 from .flow import FlowGraph
+from .lpa import LabelPropagation
 from .pagerank import PageRank
 from .rankings import DegreeRanking, Density, StarNode
 from .taint import TaintTracking
@@ -21,6 +22,7 @@ __all__ = [
     "StarNode",
     "BinaryDiffusion",
     "FlowGraph",
+    "LabelPropagation",
     "PageRank",
     "TaintTracking",
     "BFS",

@@ -125,15 +125,23 @@ def make_runner(program: VertexProgram, n: int, m: int, k: int):
             return jax.tree_util.tree_map(
                 lambda a: a.reshape((k * n,) + a.shape[2:])[ids], state)
 
+        def custom_flat(tree_flat, ids):
+            agg = program.exchange(tree_flat, ids, k * n, em_flat)
+            return jax.tree_util.tree_map(
+                lambda a: a.reshape((k, n) + a.shape[1:]), agg)
+
         def step_all(st, step):
             ek = flat_edges(step)
+            custom = program.combiner == "custom"
             agg = None
             if program.direction in ("out", "both"):
                 payload = program.message(gather_flat(st, flat_src), ek)
-                agg = combine_flat(payload, flat_dst, True)
+                agg = (custom_flat(payload, flat_dst) if custom
+                       else combine_flat(payload, flat_dst, True))
             if program.direction in ("in", "both"):
                 payload = program.message(gather_flat(st, flat_dst), ek)
-                agg_in = combine_flat(payload, flat_src, False)
+                agg_in = (custom_flat(payload, flat_src) if custom
+                          else combine_flat(payload, flat_src, False))
                 agg = agg_in if agg is None else _merge_aggs(
                     program.combiner, agg, agg_in)
 
@@ -215,6 +223,10 @@ def run_async(
                                 (BWindowed*; leading axis on the result).
     """
     batched = windows is not None
+    if program.combiner == "custom" and program.direction == "both":
+        raise ValueError(
+            "combiner='custom' requires direction 'out' or 'in' — merging "
+            "two custom aggregations is not well-defined")
     if windows is not None and len(windows) == 0:
         raise ValueError("windows must be a non-empty list of window sizes")
     if windows is None:

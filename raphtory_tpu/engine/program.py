@@ -21,7 +21,12 @@ Messages always flow along edges; ``direction`` picks out-edges ('out':
 src→dst), in-edges ('in': dst→src), or 'both'. Aggregation at the receiver is
 an associative-commutative ``combiner`` ('sum' | 'min' | 'max') — the
 narrowing of the reference's arbitrary typed messages that makes vertex
-messaging a segment reduction (SURVEY.md §2.9).
+messaging a segment reduction (SURVEY.md §2.9) — OR ``'custom'``: the
+program's ``exchange`` hook receives the raw flat payloads with their
+destination segment ids and reduces them itself (sort-based routing — see
+``ops.segment.segment_mode``), recovering inbox-style algorithms (label
+histograms, majority votes) the elementwise combiners cannot express
+(``VertexVisitor.scala:99-161`` generality).
 """
 
 from __future__ import annotations
@@ -135,6 +140,18 @@ class VertexProgram:
         """Payload sent along each edge, computed from the SENDER's state.
         For direction='in' the "sender" is the edge's dst vertex; for 'both'
         it's called once per direction."""
+        raise NotImplementedError
+
+    def exchange(self, payload: Any, seg_ids: jnp.ndarray,
+                 num_segments: int, mask: jnp.ndarray) -> Any:
+        """combiner='custom' only: reduce the flat per-edge ``payload``
+        pytree (leaves [m, ...]) into per-vertex aggregates (leaves
+        [num_segments, ...]). ``seg_ids[m]`` is each payload's destination
+        segment; rows with ``mask`` False must not contribute. Runs inside
+        the compiled superstep on every engine (single-chip and mesh) —
+        use static-shape segment ops (``segment_combine``, ``segment_mode``)
+        only. Restricted to direction 'out' or 'in' (merging two custom
+        aggregations is not well-defined)."""
         raise NotImplementedError
 
     def update(self, state: Any, agg: Any, ctx: Context):

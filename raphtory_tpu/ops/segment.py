@@ -56,3 +56,53 @@ def segment_combine(
         data, segment_ids, num_segments=num_segments,
         indices_are_sorted=indices_are_sorted,
     )
+
+
+_V_BITS = 31  # segment_mode value budget: non-negative ints < 2**31
+
+
+def segment_mode(
+    values: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: jnp.ndarray | None = None,
+    default: int = -1,
+):
+    """Most frequent value per segment; ties break to the SMALLEST value.
+
+    The sort-based generic-inbox path (SURVEY §7.3 "message-passing
+    generality"): where the reference hands each vertex a mailbox of
+    arbitrary messages (``VertexMutliQueue``), algorithms needing the full
+    inbox — label histograms, majority votes — sort the flat (segment,
+    value) pairs, count equal-value runs with one segment-sum, and reduce
+    runs per segment with one segment-max. Three XLA ops, static shapes, no
+    per-vertex loops. Values must be non-negative int32-range (< 2**31).
+
+    Segments with no (unmasked) rows get ``default``.
+    """
+    m = len(values)
+    v = values.astype(jnp.int64)
+    s = segment_ids.astype(jnp.int64)
+    if mask is not None:
+        s = jnp.where(mask, s, num_segments)  # park masked rows at the end
+    key = (s << _V_BITS) | v
+    ks = jnp.sort(key)
+    ss = ks >> _V_BITS
+    vs = ks & ((1 << _V_BITS) - 1)
+    start = jnp.concatenate(
+        [jnp.ones((1,), bool), ks[1:] != ks[:-1]])  # (seg,val) run starts
+    run_id = jnp.cumsum(start) - 1
+    run_len = jax.ops.segment_sum(
+        jnp.ones((m,), jnp.int64), run_id, num_segments=m,
+        indices_are_sorted=True)
+    # one candidate per run (its start row): score = count ⊕ inverted value,
+    # so segment-max = (max count, then min value)
+    inv_v = ((1 << _V_BITS) - 1) - vs
+    score = run_len[run_id] * (1 << _V_BITS) + inv_v
+    score = jnp.where(start, score, -1)
+    seg_of_row = jnp.where(ss < num_segments, ss, num_segments)
+    best = jax.ops.segment_max(
+        score, seg_of_row, num_segments=num_segments + 1,
+        indices_are_sorted=True)[:num_segments]
+    val = ((1 << _V_BITS) - 1) - (best & ((1 << _V_BITS) - 1))
+    return jnp.where(best > 0, val, default).astype(values.dtype)

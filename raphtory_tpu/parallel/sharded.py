@@ -319,6 +319,11 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
                 (k_loc * m_loc_s,) + a.shape[1:])
 
         def combine_flat(tree_flat, ids, msk):
+            if program.combiner == "custom":
+                agg = program.exchange(tree_flat, ids, k_loc * n_loc, msk)
+                return jax.tree_util.tree_map(
+                    lambda a: a.reshape((k_loc, n_loc) + a.shape[1:]), agg)
+
             def leaf(x):
                 out = segment_combine(x, ids, k_loc * n_loc, program.combiner,
                                       msk, indices_are_sorted=True)
@@ -487,6 +492,10 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
     smaller."""
     batched = windows is not None
     occurrences = bool(getattr(program, "needs_occurrences", False))
+    if program.combiner == "custom" and program.direction == "both":
+        raise ValueError(
+            "combiner='custom' requires direction 'out' or 'in' — merging "
+            "two custom aggregations is not well-defined")
     if windows is not None and len(windows) == 0:
         raise ValueError("windows must be a non-empty list of window sizes")
     if windows is None:
