@@ -20,7 +20,7 @@ from dataclasses import dataclass
 import jax.numpy as jnp
 import numpy as np
 
-from ..engine.program import Context, VertexProgram
+from ..engine.program import Context, Edges, VertexProgram
 from ..ops.segment import segment_mode
 
 _I32_MAX = np.int32(np.iinfo(np.int32).max)

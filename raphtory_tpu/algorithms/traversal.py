@@ -30,6 +30,8 @@ class SSSP(VertexProgram):
     weight_prop: str | None = None   # None -> unit weights (= BFS hop count)
     directed: bool = True
     max_steps: int = 100
+    top_k: int = 20                  # farthest reached vertices in the summary
+    full_distances: bool = False     # opt-in: ship every reached distance
     combiner = "min"
     needs_vertex_times = False
     needs_edge_times = False
@@ -60,18 +62,46 @@ class SSSP(VertexProgram):
         return new, new == state
 
     def reduce(self, result, view, window=None):
+        """Top-k + hop histogram summary (PageRank reducer discipline).
+
+        A range sweep runs this once per hop; shipping every reached
+        vertex's distance per hop balloons job results and REST payloads, so
+        the default reports the k farthest vertices plus a distance
+        histogram. Full per-vertex distances stay available behind
+        ``full_distances=True``.
+        """
         dist = np.asarray(result)
         reached = np.isfinite(dist) & np.asarray(view.v_mask)
-        return {
+        out = {
             "reached": int(reached.sum()),
             "max_distance": float(dist[reached].max()) if reached.any() else None,
-            "distances": {
-                int(view.vids[i]): float(dist[i]) for i in np.flatnonzero(reached)
-            },
         }
+        idx = np.flatnonzero(reached)
+        if len(idx):
+            k = min(self.top_k, len(idx))
+            part = idx[np.argpartition(dist[idx], len(idx) - k)[len(idx) - k:]]
+            order = part[np.argsort(dist[part])[::-1]]
+            out["top"] = [
+                {"vertex": int(view.vids[i]), "distance": float(dist[i])}
+                for i in order
+            ]
+            # integer-bucket histogram of reached distances (hops for BFS)
+            buckets = np.floor(dist[idx]).astype(np.int64)
+            uniq, counts = np.unique(buckets, return_counts=True)
+            out["histogram"] = {int(u): int(c) for u, c in zip(uniq, counts)}
+        else:
+            out["top"] = []
+            out["histogram"] = {}
+        if self.full_distances:
+            out["distances"] = {
+                int(view.vids[i]): float(dist[i]) for i in idx
+            }
+        return out
 
 
-def BFS(seeds: tuple = (), directed: bool = True, max_steps: int = 100) -> SSSP:
+def BFS(seeds: tuple = (), directed: bool = True, max_steps: int = 100,
+        top_k: int = 20, full_distances: bool = False) -> SSSP:
     """Hop-count traversal (unit-weight SSSP)."""
     return SSSP(seeds=seeds, weight_prop=None, directed=directed,
-                max_steps=max_steps)
+                max_steps=max_steps, top_k=top_k,
+                full_distances=full_distances)

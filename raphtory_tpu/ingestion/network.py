@@ -79,7 +79,7 @@ class KafkaSource(Source):
                 # one poll round: kafka-python's iterator raises StopIteration
                 # after consumer_timeout_ms idle; with follow=True we re-enter
                 # it (poll-forever), otherwise one round is the whole stream
-                round_start = emitted
+                round_t0 = _time.monotonic()
                 for record in consumer:
                     value = getattr(record, "value", record)
                     if isinstance(value, bytes):
@@ -92,11 +92,17 @@ class KafkaSource(Source):
                         break
                 else:
                     done = not self.follow
-                    if not done and emitted == round_start:
-                        # pace empty rounds: a consumer whose iterator drains
-                        # without blocking (list-backed fakes, clients with no
-                        # poll timeout) must not busy-spin the re-enter loop
-                        _time.sleep(self.poll_timeout_s)
+                    if not done:
+                        # pace the re-enter loop to at most one round per
+                        # poll_timeout_s: a blocking consumer (real
+                        # kafka-python waits consumer_timeout_ms when idle)
+                        # already spent the round budget and sleeps zero,
+                        # while a non-blocking injected consumer — empty OR
+                        # yielding a record per round — must not busy-spin
+                        remainder = (self.poll_timeout_s
+                                     - (_time.monotonic() - round_t0))
+                        if remainder > 0:
+                            _time.sleep(remainder)
         finally:
             close = getattr(consumer, "close", None)
             if close is not None:
@@ -187,21 +193,26 @@ class HttpPollSource(Source):
     The REST-puller shape (scalaj-http spouts): GET ``url`` every
     ``poll_s`` seconds, split the body into records with ``splitter``
     (default: JSON array → one item per element, else one per line), dedup
-    against the previously seen tail when ``dedup`` is set. ``fetch(url) ->
-    str`` is injectable for tests.
+    against the last ``dedup_depth`` polls' items when ``dedup`` is set
+    (bounded memory; widen for feeds that page items in and out, so an item
+    absent for a poll or two is not re-emitted as new when it returns).
+    ``fetch(url) -> str`` is injectable for tests.
     """
 
     def __init__(self, url: str, *, poll_s: float = 5.0,
                  max_polls: int | None = 1, name: str | None = None,
-                 disorder: int = 0, dedup: bool = True,
+                 disorder: int = 0, dedup: bool = True, dedup_depth: int = 1,
                  splitter: Callable[[str], list] | None = None,
                  fetch: Callable[[str], str] | None = None):
+        if dedup_depth < 1:
+            raise ValueError("dedup_depth must be >= 1")
         self.url = url
         self.poll_s = poll_s
         self.max_polls = max_polls
         self.name = name or f"http({url})"
         self.disorder = disorder
         self.dedup = dedup
+        self.dedup_depth = dedup_depth
         self._splitter = splitter or self._default_split
         self._fetch = fetch
 
@@ -223,8 +234,12 @@ class HttpPollSource(Source):
             raise SourceUnavailable(f"HTTP endpoint {url} unreachable") from e
 
     def __iter__(self) -> Iterator[str]:
+        from collections import deque
+
         fetch = self._fetch or self._default_fetch
-        prev: set[str] = set()  # previous poll's items only — bounded memory
+        # sliding window of the last dedup_depth polls' item sets — memory
+        # stays bounded by depth × poll size, not all history
+        recent: deque[set[str]] = deque(maxlen=self.dedup_depth)
         polls = 0
         while self.max_polls is None or polls < self.max_polls:
             if polls:
@@ -234,9 +249,9 @@ class HttpPollSource(Source):
             cur: set[str] = set()
             for item in self._splitter(body):
                 if self.dedup:
-                    dup = item in prev or item in cur
+                    dup = item in cur or any(item in s for s in recent)
                     cur.add(item)  # track even suppressed items: an item
                     if dup:        # present in EVERY poll stays deduped
                         continue
                 yield item
-            prev = cur
+            recent.append(cur)

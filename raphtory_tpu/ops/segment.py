@@ -83,8 +83,14 @@ def segment_mode(
     m = len(values)
     v = values.astype(jnp.int64)
     s = segment_ids.astype(jnp.int64)
+    # Out-of-range values would alias into neighbouring segments through the
+    # packed key; park them with the masked rows so violations degrade to
+    # "no message" instead of corrupting other segments' histograms.
+    in_range = (v >= 0) & (v < (1 << _V_BITS))
     if mask is not None:
-        s = jnp.where(mask, s, num_segments)  # park masked rows at the end
+        in_range = in_range & mask
+    s = jnp.where(in_range, s, num_segments)  # park bad rows at the end
+    v = jnp.where(in_range, v, 0)
     key = (s << _V_BITS) | v
     ks = jnp.sort(key)
     ss = ks >> _V_BITS

@@ -117,11 +117,32 @@ def test_sssp_weighted():
     log.add_edge(1, 1, 3, {"w": 1.0})
     log.add_edge(1, 3, 2, {"w": 1.0})
     view = build_view(log, 5)
-    prog = SSSP(seeds=(1,), weight_prop="w")
+    prog = SSSP(seeds=(1,), weight_prop="w", full_distances=True)
     dist, _ = bsp.run(prog, view)
     out = prog.reduce(dist, view)
     assert out["distances"][2] == 2.0  # 1->3->2 beats direct 5.0
     assert out["distances"][3] == 1.0
+
+
+def test_sssp_reducer_summarises_by_default():
+    """Default reduce ships top-k + histogram, NOT every distance — a range
+    sweep must not balloon job results/REST payloads per hop."""
+    rng = np.random.default_rng(11)
+    log = EventLog()
+    for _ in range(300):
+        a, b = (int(x) for x in rng.integers(0, 60, 2))
+        log.add_edge(int(rng.integers(0, 100)), a, b)
+    view = build_view(log, 100)
+    prog = BFS(seeds=(3,))
+    dist, _ = bsp.run(prog, view)
+    out = prog.reduce(dist, view)
+    assert "distances" not in out                  # opt-in only
+    assert len(out["top"]) <= prog.top_k
+    assert sum(out["histogram"].values()) == out["reached"]
+    if out["top"]:
+        assert out["top"][0]["distance"] == out["max_distance"]
+        tops = [t["distance"] for t in out["top"]]
+        assert tops == sorted(tops, reverse=True)
 
 
 def test_binary_diffusion_deterministic_and_spreads():

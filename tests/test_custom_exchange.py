@@ -40,6 +40,16 @@ def test_segment_mode_mask_and_default():
     np.testing.assert_array_equal(np.asarray(out), [1, -7])
 
 
+def test_segment_mode_out_of_range_values_degrade_to_no_message():
+    """Values outside [0, 2**31) must not alias into other segments through
+    the packed sort key — they degrade to 'no message' for their segment."""
+    vals = np.array([5, -3, 2**31 + 1, 5], np.int64)
+    segs = np.array([0, 1, 1, 2], np.int32)
+    out = np.asarray(segment_mode(jnp.asarray(vals), jnp.asarray(segs), 3,
+                                  default=-1))
+    assert out.tolist() == [5, -1, 5]  # seg 1 sees only bad rows -> default
+
+
 def test_segment_mode_randomised_vs_host():
     rng = np.random.default_rng(0)
     for _ in range(10):

@@ -143,6 +143,23 @@ def test_http_poll_dedup_is_tail_bounded_but_stable():
     assert ids == [1, 9, 2, 1]
 
 
+def test_http_poll_dedup_depth_widens_window():
+    """dedup_depth=2 keeps two polls of history, so an item absent for
+    exactly one poll is still suppressed when it returns."""
+    bodies = [
+        json.dumps([{"id": 1}, {"id": 9}]),
+        json.dumps([{"id": 2}, {"id": 9}]),   # 1 absent this poll
+        json.dumps([{"id": 1}, {"id": 9}]),   # 1 back -> still within window
+        json.dumps([{"id": 1}]),
+        json.dumps([{"id": 1}]),
+    ]
+    it = iter(bodies)
+    src = HttpPollSource("http://x/feed", max_polls=5, poll_s=0.0,
+                         dedup_depth=2, fetch=lambda url: next(it))
+    ids = [json.loads(i)["id"] for i in src]
+    assert ids == [1, 9, 2]
+
+
 def test_http_poll_source_lines():
     src = HttpPollSource("http://x", max_polls=1,
                          fetch=lambda url: "a,b\nc,d\n\n")
