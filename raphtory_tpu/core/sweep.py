@@ -42,6 +42,13 @@ from .snapshot import (
 _ENC_SHIFT = 32
 _ENC_MASK = (1 << _ENC_SHIFT) - 1
 
+_EMPTY_DELTA = {
+    "v_idx": np.empty(0, np.int64), "v_lat": np.empty(0, np.int64),
+    "v_alive": np.empty(0, bool), "v_first": np.empty(0, np.int64),
+    "e_enc": np.empty(0, np.int64), "e_lat": np.empty(0, np.int64),
+    "e_alive": np.empty(0, bool), "e_first": np.empty(0, np.int64),
+}
+
 
 class SweepBuilder:
     """Build views at ascending timestamps over a pinned log, incrementally.
@@ -91,6 +98,11 @@ class SweepBuilder:
         self._ea_rows = np.empty(0, np.int64)
         self._va_rows = np.empty(0, np.int64)
         self.t_prev: int | None = None
+        # last hop's touched-entity delta (dense vertex indices + packed edge
+        # keys with their POST-update fold state) — consumed by the
+        # device-resident sweep engine (engine/device_sweep.py), which ships
+        # only these O(delta) rows to the chip instead of fresh O(m) arrays
+        self.last_delta: dict | None = None
 
     # ---- helpers ----
 
@@ -132,6 +144,7 @@ class SweepBuilder:
         rows = np.flatnonzero(sel)
         self.t_prev = time
         if len(rows) == 0:
+            self.last_delta = _EMPTY_DELTA
             return
         t = self._t[rows]
         k = self._k[rows]
@@ -141,6 +154,7 @@ class SweepBuilder:
         is_vd = k == VERTEX_DELETE
         is_ea = k == EDGE_ADD
         is_ed = k == EDGE_DELETE
+        uvd = uenc = None  # touched entities, recorded into last_delta below
 
         new_ea = rows[is_ea]
         new_va = rows[is_va]
@@ -245,6 +259,19 @@ class SweepBuilder:
             order = np.argsort(self.dh_v, kind="stable")
             self.dh_v = self.dh_v[order]
             self.dh_t = self.dh_t[order]
+
+        # Touched-entity delta with POST-update fold state, read back from the
+        # running arrays so it is correct no matter which code path (known
+        # pair overwrite / fresh insert / tombstone join) produced the value.
+        tv = uvd if uvd is not None else np.empty(0, np.int64)
+        te = uenc if uenc is not None else np.empty(0, np.int64)
+        epos = np.searchsorted(self.e_enc, te)
+        self.last_delta = {
+            "v_idx": tv, "v_lat": self.v_lat[tv],
+            "v_alive": self.v_alive[tv], "v_first": self.v_first[tv],
+            "e_enc": te, "e_lat": self.e_lat[epos],
+            "e_alive": self.e_alive[epos], "e_first": self.e_first[epos],
+        }
 
     def _emit(self, time: int) -> GraphView:
         act_dense = np.flatnonzero(self.v_alive)

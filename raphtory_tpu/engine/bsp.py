@@ -49,7 +49,24 @@ def make_runner(program: VertexProgram, n: int, m: int, k: int):
     little bit order). Arrays a program opts out of (``needs_vids`` /
     ``needs_vertex_times`` / ``needs_edge_times`` False) may be passed as
     1-element dummies — the runner substitutes pad defaults on device, so
-    the host never stages or transfers them.
+    the host never stages or transfers them."""
+    core = make_mask_runner(program, n, m, k)
+
+    def run(v_masks_p, e_masks_p, vids, v_latest, v_first,
+            e_src, e_dst, e_latest, e_first,
+            time, windows, eprops, vprops):
+        return core(_unpack_bits(v_masks_p, n), _unpack_bits(e_masks_p, m),
+                    vids, v_latest, v_first, e_src, e_dst, e_latest, e_first,
+                    time, windows, eprops, vprops)
+
+    return run
+
+
+def make_mask_runner(program: VertexProgram, n: int, m: int, k: int):
+    """The superstep core over UNPACKED bool masks (v_masks[k,n],
+    e_masks[k,m]) — shared by the bit-packed host path (``make_runner``) and
+    the device-resident sweep engine (``device_sweep.py``), which computes
+    the masks on device and so never packs.
 
     The window batch is evaluated as ONE FLAT graph of k*n vertices / k*m
     edges (per-window segment ids offset by kk*n) rather than vmapping the
@@ -61,11 +78,9 @@ def make_runner(program: VertexProgram, n: int, m: int, k: int):
     tests/test_engine_algorithms.py::
     test_pagerank_batched_windows_match_single)."""
 
-    def run(v_masks_p, e_masks_p, vids, v_latest, v_first,
+    def run(v_masks, e_masks, vids, v_latest, v_first,
             e_src, e_dst, e_latest, e_first,
             time, windows, eprops, vprops):
-        v_masks = _unpack_bits(v_masks_p, n)
-        e_masks = _unpack_bits(e_masks_p, m)
         if not program.needs_vids:
             vids = jnp.full((n,), -1, jnp.int64)
         if not program.needs_vertex_times:

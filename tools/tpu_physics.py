@@ -1,0 +1,89 @@
+"""Measured device physics for the attached accelerator — the cost model the
+engine design is built on.
+
+Timing rules learned the hard way (this backend is reached through a
+transfer tunnel that CACHES identical submissions and whose
+``block_until_ready`` can return early on cache hits):
+
+* vary the input buffer every call (``x * 1.0000001``) so no layer can serve
+  a cached result;
+* never embed large index arrays as jit CONSTANTS — the tunnel
+  rematerialises constants per call (~18 ms for 6 MB); pass them as args;
+* amortise the per-dispatch cost by looping on device (``lax.scan``) and
+  sync ONCE; pull only scalars to host.
+
+Run: python tools/tpu_physics.py  (prints one JSON line per primitive)
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def harness(make_run, x0, *args, steps=5, label="", detail=""):
+    import jax
+
+    run = jax.jit(make_run)
+    r = run(x0, *args)
+    jax.block_until_ready(r)
+    x = x0 * 1.0000001
+    jax.block_until_ready(x)
+    t0 = time.perf_counter()
+    r = run(x, *args)
+    jax.block_until_ready(r)
+    ms = (time.perf_counter() - t0) / steps * 1000
+    print(json.dumps({"primitive": label, "ms_per_step": round(ms, 3),
+                      "detail": detail}))
+    return ms
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+    print(json.dumps({"device": dev.platform, "kind": dev.device_kind}))
+
+    def scan5(body):
+        def f(x, *a):
+            r, _ = jax.lax.scan(lambda c, _: (body(c, *a), None), x, None,
+                                length=5)
+            return r
+        return f
+
+    small = jnp.asarray(rng.random((768, 128), dtype=np.float32))
+    harness(scan5(lambda c: c * 0.999 + 0.001), small,
+            label="elementwise_98k", detail="fixed per-step overhead floor")
+
+    mid = jnp.asarray(rng.random((8192, 4096), dtype=np.float32))  # 128MB
+    harness(scan5(lambda c: c * 0.99999), mid,
+            label="elementwise_128MB", detail="~256MB traffic/step")
+
+    xf = jnp.asarray(rng.random((1_572_864,), dtype=np.float32))
+    gidx = jnp.asarray(rng.integers(0, 1_572_864, 1_572_864).astype(np.int32))
+    harness(scan5(lambda c, g: c * 0.999 + c[g] * 1e-9), xf, gidx,
+            label="flat_gather_1.6M", detail="per-element random access")
+
+    sdst = jnp.asarray(np.sort(rng.integers(0, 98304, 1_572_864)).astype(np.int32))
+    harness(scan5(lambda c, d: c * 0.999 + jnp.tile(jax.ops.segment_sum(
+        c, d, num_segments=98304, indices_are_sorted=True), 16) * 1e-9),
+        xf, sdst, label="segment_sum_1.6M", detail="sorted scatter-add")
+
+    harness(scan5(lambda c: jnp.cumsum(c) * 1e-3), xf,
+            label="cumsum_flat_1.6M", detail="prefix scan")
+
+    tab = jnp.asarray(rng.random((262144, 128), dtype=np.float32))
+    ridx = jnp.asarray(rng.integers(0, 262144, 2_000_000).astype(np.int32))
+    harness(scan5(lambda c, i: c * 0.999 + c[i, :][:262144] * 1e-9), tab, ridx,
+            label="row_gather_2M_rows",
+            detail="128-wide tile gather (1GB out) — the fast sparse path")
+
+    a = jnp.asarray(rng.random((4096, 4096), dtype=np.float32))
+    harness(scan5(lambda c: (c @ c) * 1e-4 + c * 0.5), a,
+            label="matmul_4096", detail="137 GFLOP/step, MXU")
+
+
+if __name__ == "__main__":
+    main()
