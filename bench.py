@@ -129,14 +129,21 @@ def _range_sweep_device(programs, log, view_times, windows):
 
     kw = {"windows": windows} if windows else {}
 
-    # warmup on real shapes: first hop compiles the superstep runner(s),
-    # second hop the delta-scatter program
+    # warmup on real shapes: first hop compiles the superstep runner(s);
+    # the empty-chunk apply compiles the delta-scatter program even when
+    # the early hops take the full-refresh path. Block before the timer —
+    # dispatches are async and would otherwise execute inside the timed
+    # region (and only on the device path, biasing the comparison).
     warm = DeviceSweep(log)
+    warm_results = []
     for T in view_times[:2]:
         warm.advance(int(T))
         for p in programs:
-            warm.run(p, **kw)
-    del warm
+            warm_results.append(warm.run(p, **kw)[0])
+    warm._apply_chunk(*([np.empty(0, np.int64)] * 8))
+    jax.block_until_ready(warm_results)
+    jax.block_until_ready(warm._bufs)
+    del warm, warm_results
 
     snap_s = 0.0
     t0 = _time.perf_counter()

@@ -36,7 +36,9 @@ class NodeRuntime:
         self.manager = AnalysisManager(self.graph, mesh=mesh)
         self.archivist = Archivist(
             self.graph, max_events=self.settings.max_events,
-            archive_fraction=self.settings.archive_fraction)
+            archive_fraction=self.settings.archive_fraction,
+            compressing=self.settings.compressing,
+            archiving=self.settings.archiving)
         self._rest = None
         self._metrics = None
         self._members: list[tuple[str, int]] = []  # (role, id) this node owns
@@ -49,7 +51,7 @@ class NodeRuntime:
         self._members.append(("job-server", self.watchdog.join("job-server")))
         self.scheduler.recurring(
             "keep-alive", s.heartbeat_interval_s, self._beat_all)
-        if s.archiving:
+        if s.archiving or s.compressing:
             self.scheduler.recurring(
                 "archivist", s.archivist_interval_s,
                 self.archivist.maybe_compact)

@@ -221,6 +221,105 @@ class GraphView:
         out[ok] = val[pos[ok]]
         return out
 
+    def vertex_prop_history(self, name: str, window: int | None = None,
+                            strings: bool = False):
+        """Per-vertex property UPDATE HISTORY at or before T — the analogue of
+        ``VertexVisitor.getPropertyHistory`` / ``getPropertySetAfterTime``
+        (``VertexVisitor.scala:48-79``), which ``vertex_prop``'s
+        latest-value fold cannot answer.
+
+        Returns ``(indptr, times, values)``: vertex local row i's updates are
+        ``times[indptr[i]:indptr[i+1]]`` / ``values[...]``, ascending in
+        (time, arrival). ``window`` keeps only updates in ``[T-window, T]``.
+        ``strings=True`` reads the string column (object array); default
+        numeric (f64). Host-side, reducer-facing — histories are ragged and
+        never ship to device."""
+        rows = self._vadd_rows
+        keys = (self._log.column("src")[rows],)
+        ent = self._prop_history_rows(rows, name, window, strings, keys)
+        if ent is None:
+            return (np.zeros(self.n_pad + 1, np.int64),
+                    np.empty(0, np.int64),
+                    np.empty(0, object if strings else np.float64))
+        evs, vals, t, kcols = ent
+        pos = self.local_index(kcols[0])
+        return self._group_history(pos, self.n_pad, evs, vals, t, strings)
+
+    def edge_prop_history(self, name: str, window: int | None = None,
+                          strings: bool = False):
+        """Per-edge property update history at or before T, grouped by the
+        view's edge rows (``EdgeVisitor.scala`` history access parity).
+        Returns ``(indptr[m_pad+1], times, values)`` over the (dst,src)-sorted
+        edge rows; dead/padded rows have empty ranges."""
+        rows = self._eadd_rows
+        log = self._log
+        keys = (log.column("src")[rows], log.column("dst")[rows])
+        ent = self._prop_history_rows(rows, name, window, strings, keys)
+        if ent is None:
+            return (np.zeros(self.m_pad + 1, np.int64),
+                    np.empty(0, np.int64),
+                    np.empty(0, object if strings else np.float64))
+        evs, vals, t, kcols = ent
+        sl = self.local_index(kcols[0])
+        dl = self.local_index(kcols[1])
+        # edge row lookup among the (dst,src)-sorted view edges
+        kview = (self.e_dst.astype(np.int64) << 32) | self.e_src
+        kq = (dl << 32) | sl
+        ok = (sl >= 0) & (dl >= 0)
+        p = np.searchsorted(kview[: self.m_active], kq)
+        p = np.clip(p, 0, max(self.m_active - 1, 0))
+        hit = ok & (self.m_active > 0)
+        if self.m_active:
+            hit &= kview[p] == kq
+            hit &= self.e_mask[p]
+        pos = np.where(hit, p, -1)
+        return self._group_history(pos, self.m_pad, evs, vals, t, strings)
+
+    def _prop_history_rows(self, rows, name, window, strings, keys):
+        """Shared join: property rows of `name` on the in-time add events
+        `rows`, time-filtered to [T-window, T]. Returns
+        (event_rows, values, times, key_columns) or None."""
+        log = self._log
+        if log is None or rows is None or name not in log.props._key_ids:
+            return None
+        props = log.props
+        kid = props._key_ids[name]
+        want_tag = props.STR_TAG if strings else props.NUM_TAG
+        sel = (props.column("key") == kid) & (props.column("tag") == want_tag)
+        if not sel.any():
+            return None
+        ev = props.column("event")[sel]
+        raw = props.column("sref")[sel] if strings else props.column("num")[sel]
+        pos = np.searchsorted(rows, ev)
+        pos = np.clip(pos, 0, max(len(rows) - 1, 0))
+        hit = (rows[pos] == ev) if len(rows) else np.zeros(len(ev), bool)
+        ev, raw, pos = ev[hit], raw[hit], pos[hit]
+        t = log.column("time")[ev]
+        intime = t <= self.time
+        if window is not None:
+            intime &= t >= self.time - int(window)
+        ev, raw, pos, t = ev[intime], raw[intime], pos[intime], t[intime]
+        if len(ev) == 0:
+            return None
+        if strings:
+            vals = np.array([props.string(int(r)) for r in raw], object)
+        else:
+            vals = raw
+        return ev, vals, t, tuple(k[pos] for k in keys)
+
+    @staticmethod
+    def _group_history(pos, n_groups, evs, vals, t, strings):
+        """(entity position per row, ...) → CSR (indptr, times, values)."""
+        keep = pos >= 0
+        pos, evs, vals, t = pos[keep], evs[keep], vals[keep], t[keep]
+        order = np.lexsort((evs, t, pos))
+        pos, vals, t = pos[order], vals[order], t[order]
+        counts = np.bincount(pos, minlength=n_groups) if len(pos) else \
+            np.zeros(n_groups, np.int64)
+        indptr = np.zeros(n_groups + 1, np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        return indptr, t, (vals if strings else vals.astype(np.float64))
+
     def local_index(self, global_ids) -> np.ndarray:
         """Map global vertex ids → local indices (-1 if absent/padded)."""
         g = np.asarray(global_ids, np.int64)

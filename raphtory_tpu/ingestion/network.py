@@ -1,14 +1,15 @@
-"""Network sources — Kafka, JSON-RPC and HTTP-poll spouts.
+"""Network + database sources — Kafka, JSON-RPC, HTTP-poll, Mongo, SQL.
 
 Parity with the reference's live spouts: ``GabKafkaSpout``
 (``examples/gab/actors/GabKafkaSpout.scala:15-38`` — consumer poll loop
 emitting each record downstream), the blockchain JSON-RPC block pullers
 (``EthereumGethSpout.scala:39-62`` — poll chain head, page through blocks),
-and the scalaj-http REST pullers. Each source here is the same loop shape
-over an *injectable transport*: production uses a real Kafka client /
-urllib; tests (and this zero-egress image) inject fakes. Client libraries
-are imported lazily and failures raise a clear error — the framework never
-hard-depends on them.
+the scalaj-http REST pullers, the Mongo window scanner (``GabRawSpout``)
+and the Postgres batch puller (``EthereumPostgresSpout``). Each source here
+is the same loop shape over an *injectable transport*: production uses a
+real client library / urllib; tests (and this zero-egress image) inject
+fakes. Client libraries are imported lazily and failures raise a clear
+error — the framework never hard-depends on them.
 """
 
 from __future__ import annotations
@@ -185,6 +186,155 @@ class JsonRpcSource(Source):
             if not self.follow:
                 return
             _time.sleep(self.poll_s)
+
+
+class MongoWindowSource(Source):
+    """Windowed ``_id``-range scan over a Mongo collection.
+
+    Mirrors ``GabRawSpout`` (``GabRawSpout.scala:36-60``): repeatedly fetch
+    documents with ``min_id < _id < min_id + window``, emit one field of each
+    document as the raw tuple, advance the window, skip malformed records
+    (the reference's catch-and-continue). ``collection_factory(host, port,
+    db, collection)`` must return an object with
+    ``find_range(lo, hi) -> iterable of dicts``; the default wraps pymongo's
+    ``find({"_id": {"$gt": lo, "$lt": hi}})`` when installed.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 27017, *,
+                 db: str = "gab", collection: str = "posts",
+                 field: str = "data", window: int = 1000, start: int = 0,
+                 max_id: int | None = None, follow: bool = False,
+                 poll_s: float = 0.01, max_empty_rounds: int = 3,
+                 name: str | None = None, disorder: int = 0,
+                 collection_factory: Callable | None = None):
+        self.host, self.port = host, port
+        self.db, self.collection = db, collection
+        self.field = field
+        self.window = window
+        self.start = start
+        self.max_id = max_id
+        self.follow = follow
+        self.poll_s = poll_s
+        self.max_empty_rounds = max_empty_rounds
+        self.name = name or f"mongo({db}.{collection})"
+        self.disorder = disorder
+        self._collection_factory = collection_factory
+
+    def _make_collection(self):
+        if self._collection_factory is not None:
+            return self._collection_factory(self.host, self.port, self.db,
+                                            self.collection)
+        try:
+            import pymongo  # type: ignore
+        except ImportError as e:
+            raise SourceUnavailable(
+                "MongoWindowSource needs pymongo (not installed); pass "
+                "collection_factory= to use a custom client") from e
+        coll = pymongo.MongoClient(self.host, self.port)[self.db][self.collection]
+
+        class _Wrap:
+            def find_range(self, lo, hi):
+                return coll.find({"_id": {"$gt": lo, "$lt": hi}})
+
+        return _Wrap()
+
+    def __iter__(self) -> Iterator[str]:
+        coll = self._make_collection()
+        lo = self.start
+        empty_rounds = 0
+        while True:
+            hi = lo + self.window + 1
+            count = 0
+            for doc in coll.find_range(lo, hi):
+                try:
+                    value = doc[self.field]
+                except (KeyError, TypeError):
+                    continue  # "Cannot parse record" — skip, keep going
+                count += 1
+                yield value if isinstance(value, str) else json.dumps(value)
+            lo += self.window
+            if self.max_id is not None:
+                # explicitly bounded scan: page every window up to max_id
+                # regardless of sparse _id gaps (the reference pages until
+                # its max unconditionally)
+                if lo >= self.max_id:
+                    return
+                continue
+            if count == 0:
+                empty_rounds += 1
+                if not self.follow and empty_rounds >= self.max_empty_rounds:
+                    return
+                _time.sleep(self.poll_s)
+            else:
+                empty_rounds = 0
+
+
+class SqlBatchSource(Source):
+    """Windowed batch reads over a SQL store — the Postgres spout shape.
+
+    Mirrors ``EthereumPostgresSpout`` (``EthereumPostgresSpout.scala:35-55``):
+    page a table by a monotone integer column in ``batch``-sized windows from
+    ``start`` to ``max_value``, emitting one CSV line per row.
+    ``execute(sql, params) -> iterable of row tuples`` is injectable; the
+    default connects with psycopg2 when installed. The query is built from
+    ``columns``/``table``/``batch_column`` (the reference's
+    from/to/value/timestamp transaction pull is the default shape).
+    """
+
+    def __init__(self, dsn: str = "dbname=ether user=postgres", *,
+                 table: str = "transactions",
+                 columns=("from_address", "to_address", "value",
+                          "block_timestamp"),
+                 batch_column: str = "block_number",
+                 start: int = 46_147, batch: int = 100,
+                 max_value: int = 8_828_337,
+                 name: str | None = None, disorder: int = 0,
+                 execute: Callable | None = None):
+        self.dsn = dsn
+        self.table = table
+        self.columns = tuple(columns)
+        self.batch_column = batch_column
+        self.start = start
+        self.batch = batch
+        self.max_value = max_value
+        self.name = name or f"sql({table})"
+        self.disorder = disorder
+        self._execute = execute
+
+    def _connect(self):
+        try:
+            import psycopg2  # type: ignore
+        except ImportError as e:
+            raise SourceUnavailable(
+                "SqlBatchSource needs psycopg2 (not installed); pass "
+                "execute= to use a custom client") from e
+        return psycopg2.connect(self.dsn)
+
+    def __iter__(self) -> Iterator[str]:
+        sql = (f"select {', '.join(self.columns)} from {self.table} "
+               f"where {self.batch_column} >= %s and {self.batch_column} < %s")
+        conn = None
+        if self._execute is not None:
+            execute = self._execute
+        else:
+            # one connection for the whole scan (~90k windows at the
+            # defaults) — the reference holds a single transactor too
+            conn = self._connect()
+
+            def execute(q, params):
+                with conn.cursor() as cur:
+                    cur.execute(q, params)
+                    return cur.fetchall()
+
+        try:
+            lo = self.start
+            while lo <= self.max_value:
+                for row in execute(sql, (lo, lo + self.batch)):
+                    yield ",".join(str(c) for c in row)
+                lo += self.batch
+        finally:
+            if conn is not None:
+                conn.close()
 
 
 class HttpPollSource(Source):

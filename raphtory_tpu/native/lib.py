@@ -9,9 +9,11 @@ means the numpy paths run.
 from __future__ import annotations
 
 import ctypes
+import logging
 
 import numpy as np
 
+_log = logging.getLogger(__name__)
 _lib = None
 _tried = False
 
@@ -28,10 +30,19 @@ def _load():
 
     path = lib_path()
     if path is None:
+        # one-time heads-up: every `_native.x or numpy` dispatch in the
+        # package now takes the interpreted path (including the O(queries)
+        # _lex_lookup loop on edge-property materialisation)
+        _log.warning(
+            "raphtory_tpu native kernels unavailable (build disabled or "
+            "failed) — falling back to slower numpy/Python paths")
         return None
     try:
         lib = ctypes.CDLL(str(path))
-    except OSError:
+    except OSError as e:
+        _log.warning(
+            "raphtory_tpu native kernel library failed to load (%s) — "
+            "falling back to slower numpy/Python paths", e)
         return None
     lib.rtpu_sort_events.restype = None
     lib.rtpu_sort_events.argtypes = [

@@ -399,13 +399,24 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
                 jnp.arange(k_loc), st, agg)
 
 
+        def vary(x):
+            """Promote x to varying over exactly the mesh axes it is missing
+            (no-op when already fully varying) — shard_map's check_vma
+            requires explicit promotion of shard-invariant values."""
+            missing = tuple(a for a in (W_AXIS, V_AXIS)
+                            if a not in jax.typeof(x).vma)
+            return jax.lax.pcast(x, missing, to="varying") if missing else x
+
         if program.max_steps > 0:
             def cond(carry):
                 step, _, halted = carry
                 # halted is per-window and identical on every vertex shard
                 # (derived from a psum over V); any unhalted window anywhere
                 # keeps every device stepping — SPMD-uniform condition.
-                unhalted = jnp.sum((~halted).astype(jnp.int32))
+                # vary() marks the (possibly vertex-invariant) count varying
+                # so the full-mesh psum type-checks under check_vma; summing
+                # S_v identical copies only scales the >0 test.
+                unhalted = vary(jnp.sum((~halted).astype(jnp.int32)))
                 unhalted = jax.lax.psum(unhalted, reduce_axes)
                 return (step < program.max_steps) & (unhalted > 0)
 
@@ -426,8 +437,15 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
                     st, new_st)
                 return step + 1, st, halted | new_halt
 
+            # The loop body makes every carry leaf varying over the whole
+            # mesh (state via the exchange, halted via the psum), but leaves
+            # a program's init() built from constants start invariant —
+            # promote each initial leaf to varying over exactly the axes it
+            # is missing so the while_loop carry is type-stable.
+            halted0 = vary(jnp.zeros((k_loc,), bool))
+            state0 = jax.tree_util.tree_map(vary, state0)
             steps, state, _ = jax.lax.while_loop(
-                cond, body, (jnp.int32(0), state0, jnp.zeros((k_loc,), bool)))
+                cond, body, (jnp.int32(0), state0, halted0))
         else:
             steps, state = jnp.int32(0), state0
 
@@ -474,7 +492,7 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
         return result, steps
 
     fn = jax.shard_map(squeeze_fn, mesh=mesh, in_specs=in_specs,
-                       out_specs=out_specs, check_vma=False)
+                       out_specs=out_specs, check_vma=True)
     return jax.jit(fn)
 
 
@@ -551,29 +569,49 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
         tuple(program.edge_props), comm,
         sv.h_d if comm == "halo" else 0, sv.h_s if comm == "halo" else 0)
 
+    # Multi-host (DCN) runs: every process holds the same full host arrays
+    # (data-replicated ingestion — the reference replays every update to
+    # every PM's router the same way), so each input becomes a GLOBAL
+    # jax.Array by slicing out this process's addressable shards. On one
+    # process this degrades to a plain device put.
+    multi = jax.process_count() > 1
+
+    def dev(x, spec):
+        if not multi:
+            return jnp.asarray(x)
+        x = np.asarray(x)
+        sh = jax.sharding.NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+
+    kv, v, rep = P(W_AXIS, V_AXIS), P(V_AXIS), P()
     halo = {}
     if comm == "halo":
-        halo = {"d_src_h": jnp.asarray(sv.d_src_h),
-                "d_send": jnp.asarray(sv.d_send),
-                "s_dst_h": jnp.asarray(sv.s_dst_h),
-                "s_send": jnp.asarray(sv.s_send)}
+        halo = {"d_src_h": dev(sv.d_src_h, v), "d_send": dev(sv.d_send, v),
+                "s_dst_h": dev(sv.s_dst_h, v), "s_send": dev(sv.s_send, v)}
 
     result, steps = runner(
-        jnp.asarray(v_masks), jnp.asarray(sv.vids), jnp.asarray(sv.v_latest),
-        jnp.asarray(sv.v_first),
-        jnp.asarray(sv.d_src_g), jnp.asarray(sv.d_dst_l), jnp.asarray(d_masks),
-        jnp.asarray(sv.d_time), jnp.asarray(sv.d_first),
-        jnp.asarray(sv.s_dst_g), jnp.asarray(sv.s_src_l), jnp.asarray(s_masks),
-        jnp.asarray(sv.s_time), jnp.asarray(sv.s_first),
+        dev(v_masks, kv), dev(sv.vids, v), dev(sv.v_latest, v),
+        dev(sv.v_first, v),
+        dev(sv.d_src_g, v), dev(sv.d_dst_l, v), dev(d_masks, kv),
+        dev(sv.d_time, v), dev(sv.d_first, v),
+        dev(sv.s_dst_g, v), dev(sv.s_src_l, v), dev(s_masks, kv),
+        dev(sv.s_time, v), dev(sv.s_first, v),
         halo,
-        {kk: jnp.asarray(vv) for kk, vv in sv.d_props.items()},
-        {kk: jnp.asarray(vv) for kk, vv in sv.s_props.items()},
-        {kk: jnp.asarray(
-            np.asarray(view.vertex_prop(kk), np.float32).reshape(S, sv.n_loc))
+        {kk: dev(vv, v) for kk, vv in sv.d_props.items()},
+        {kk: dev(vv, v) for kk, vv in sv.s_props.items()},
+        {kk: dev(
+            np.asarray(view.vertex_prop(kk), np.float32).reshape(S, sv.n_loc),
+            v)
          for kk in program.vertex_props},
-        jnp.asarray(view.time, jnp.int64),
-        jnp.asarray(wlist_p, jnp.int64),
+        dev(np.asarray(view.time, np.int64), rep),
+        dev(np.asarray(wlist_p, np.int64), P(W_AXIS)),
     )
+    if multi:
+        # replicate the (cross-host sharded) result back to every host —
+        # job reducers are host code and expect the full arrays
+        from jax.experimental import multihost_utils
+
+        result = multihost_utils.process_allgather(result, tiled=True)
     # merge shard axis back into global vertex order: [K, S, n_loc] -> [K, n]
     result = jax.tree_util.tree_map(
         lambda a: np.asarray(a).reshape((k_pad, view.n_pad) + a.shape[3:]),

@@ -232,19 +232,29 @@ def _attach_baseline_props(log: EventLog, out: EventLog, cutoff: int,
 
 
 class Archivist:
-    """Memory governor: when the log exceeds a budget, archive the oldest
-    fraction of the time span (the reference's 90/10 policy,
-    ``Archivist.scala:38-39,143-159``)."""
+    """Memory governor running the reference's TWO-PHASE cycle: when the log
+    exceeds its budget, COMPRESS history older than 90% of the span (dedup
+    redundant aliveness runs — ``Archivist.scala:66-122`` compressGraph) and
+    ARCHIVE the oldest 10% outright (``Archivist.scala:138-159``
+    archiveGraph). Each phase is gated by its flag, mirroring the
+    ``compressing``/``archiving`` env switches (``Utils.scala:22-26``)."""
 
     def __init__(self, graph, max_events: int = 50_000_000,
-                 archive_fraction: float = 0.1):
+                 archive_fraction: float = 0.1,
+                 compress_fraction: float = 0.9,
+                 compressing: bool = True, archiving: bool = True):
         self.graph = graph
         self.max_events = max_events
         self.archive_fraction = archive_fraction
+        self.compress_fraction = compress_fraction
+        self.compressing = compressing
+        self.archiving = archiving
 
     def maybe_compact(self) -> bool:
         log = self.graph.log
         if log.n <= self.max_events:
+            return False
+        if not (self.compressing or self.archiving):
             return False
         # Rewrite a frozen prefix while ingestion continues, then atomically
         # splice the concurrent tail back in compact_to — every holder of
@@ -253,10 +263,21 @@ class Archivist:
         t0 = _time.perf_counter()
         frozen = log.freeze()
         span = log.max_time - log.min_time
-        cutoff = log.min_time + int(span * self.archive_fraction) + 1
-        new_log = archive_events(frozen, cutoff)
+        new_log = frozen
+        if self.compressing:
+            c_cut = log.min_time + int(span * self.compress_fraction)
+            new_log = compress_events(new_log, c_cut)
+            METRICS.compactions.labels("compress").inc()
+        if self.archiving:
+            a_cut = log.min_time + int(span * self.archive_fraction) + 1
+            new_log = archive_events(new_log, a_cut)
+            METRICS.compactions.labels("archive").inc()
+        if new_log.n >= frozen.n:
+            # nothing shrank (e.g. compress-only on already-compressed
+            # history) — skip the splice, or every governor tick would
+            # rewrite the whole log and invalidate caches for nothing
+            return False
         log.compact_to(new_log, since_row=frozen.n)
         self.graph.invalidate_cache()
-        METRICS.compactions.labels("archive").inc()
         METRICS.compaction_seconds.observe(_time.perf_counter() - t0)
         return True

@@ -183,3 +183,113 @@ def test_kafka_source_through_pipeline():
     view = g.view_at(29)
     assert view.n_active == 5
     assert view.m_active > 0
+
+
+# ---------------------------------------------------------------- db spouts
+
+
+class _FakeMongoColl:
+    """Docs keyed by integer _id, like the Gab posts collection."""
+
+    def __init__(self, docs):
+        self.docs = docs          # {_id: doc}
+        self.calls = []
+
+    def find_range(self, lo, hi):
+        self.calls.append((lo, hi))
+        return [self.docs[i] for i in sorted(self.docs) if lo < i < hi]
+
+
+def test_mongo_window_source_scans_ranges_and_skips_bad_docs():
+    from raphtory_tpu.ingestion.network import MongoWindowSource
+
+    docs = {1: {"data": "a"}, 2: {"nope": 1}, 1500: {"data": "b"},
+            2400: {"data": {"k": 1}}}
+    coll = _FakeMongoColl(docs)
+    src = MongoWindowSource(
+        window=1000, start=0, max_id=3000,
+        collection_factory=lambda h, p, db, c: coll)
+    out = list(src)
+    assert out == ["a", "b", json.dumps({"k": 1})]  # bad doc skipped
+    # windows advanced by `window` like the reference's postMin += window
+    assert coll.calls[0] == (0, 1001)
+    assert coll.calls[1] == (1000, 2001)
+
+
+def test_mongo_window_source_stops_after_empty_rounds():
+    from raphtory_tpu.ingestion.network import MongoWindowSource
+
+    coll = _FakeMongoColl({5: {"data": "x"}})
+    src = MongoWindowSource(window=10, poll_s=0, max_empty_rounds=2,
+                            collection_factory=lambda *a: coll)
+    assert list(src) == ["x"]
+    assert len(coll.calls) >= 3  # the two empty rounds ran before stopping
+
+
+def test_mongo_source_without_pymongo_raises_unavailable():
+    from raphtory_tpu.ingestion.network import MongoWindowSource
+
+    with pytest.raises(SourceUnavailable):
+        list(MongoWindowSource())
+
+
+def test_sql_batch_source_pages_blocks_and_emits_csv():
+    from raphtory_tpu.ingestion.network import SqlBatchSource
+
+    rows_by_window = {
+        (100, 150): [("a", "b", 10, 1111)],
+        (150, 200): [],
+        (200, 250): [("c", "d", 20, 2222), ("e", "f", 30, 3333)],
+    }
+    calls = []
+
+    def execute(sql, params):
+        calls.append((sql, params))
+        return rows_by_window.get(params, [])
+
+    src = SqlBatchSource(start=100, batch=50, max_value=220, execute=execute)
+    assert list(src) == ["a,b,10,1111", "c,d,20,2222", "e,f,30,3333"]
+    assert calls[0][1] == (100, 150)
+    assert "from_address, to_address, value, block_timestamp" in calls[0][0]
+    assert "block_number >= %s and block_number < %s" in calls[0][0]
+    # paging stopped past max_value (reference's maxblock stop)
+    assert calls[-1][1] == (200, 250)
+
+
+def test_sql_source_feeds_ingestion_pipeline():
+    """End-to-end: SQL rows → CSV parser → event log (the reference's
+    spout→router→graph path)."""
+    from raphtory_tpu.core.service import TemporalGraph
+    from raphtory_tpu.ingestion.network import SqlBatchSource
+    from raphtory_tpu.ingestion.parser import Parser
+    from raphtory_tpu.ingestion.pipeline import IngestionPipeline
+    from raphtory_tpu.ingestion.updates import EdgeAdd
+
+    class TxParser(Parser):
+        def __call__(self, raw):
+            f, t, v, ts = raw.split(",")
+            return [EdgeAdd(int(ts), hash(f) % 997, hash(t) % 997,
+                            {"value": float(v)})]
+
+    src = SqlBatchSource(
+        start=0, batch=10, max_value=10,
+        execute=lambda sql, p: [("x", "y", 5, 42), ("y", "z", 6, 43)])
+    g = TemporalGraph()
+    pipe = IngestionPipeline(g.log, watermarks=g.watermarks)
+    pipe.add_source(src, TxParser())
+    pipe.run()
+    assert not pipe.errors
+    assert g.log.n == 4  # 2 windows ([0,10), [10,20)) x 2 rows each
+
+
+def test_mongo_bounded_scan_pages_through_sparse_gaps():
+    """With max_id set, empty windows must not end the scan — documents
+    past a sparse _id gap are still reached (reference pages to its max
+    unconditionally)."""
+    from raphtory_tpu.ingestion.network import MongoWindowSource
+
+    coll = _FakeMongoColl({5000: {"data": "late"}})
+    src = MongoWindowSource(window=1000, start=0, max_id=6000, poll_s=0,
+                            max_empty_rounds=1,
+                            collection_factory=lambda *a: coll)
+    assert list(src) == ["late"]

@@ -155,6 +155,90 @@ def test_archivist_policy_compacts_in_place():
     assert not arch2.maybe_compact()
 
 
+def test_archivist_two_phase_compress_and_archive_under_live_ingest():
+    """The reference's full Archivist cycle: compress at the 90% cutoff AND
+    archive the oldest 10%, while a concurrent writer keeps appending.
+    Views at post-archive-cutoff times must be identical before/after."""
+    import threading
+    import time as _t
+
+    # redundant alive-runs (same vertex re-added) make compression bite
+    log = EventLog()
+    for t in range(0, 1000, 10):
+        for v in range(10):
+            log.add_vertex(t, v)                    # long redundant runs
+        log.add_edge(t, t % 10, (t + 1) % 10, {"w": float(t)})
+    g = TemporalGraph(log)
+    n_initial = log.n  # all events so far have time <= 990
+    want = {T: (_verts(build_view(log, T)), _edges(build_view(log, T)))
+            for T in (150, 500, 990)}
+
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            # bounded times so the archive cutoff (10% of span) stays below
+            # the checked view times regardless of writer speed
+            log.add_edge(1000 + i % 50, i % 7, (i + 3) % 7)
+            i += 1
+
+    th = threading.Thread(target=writer, daemon=True)
+    th.start()
+    try:
+        _t.sleep(0.02)
+        arch = Archivist(g, max_events=100, archive_fraction=0.1,
+                         compress_fraction=0.9, compressing=True,
+                         archiving=True)
+        assert arch.maybe_compact()
+    finally:
+        stop.set()
+        th.join(2)
+    # both phases ran: archive drops t < ~101 and compression collapses the
+    # redundant vertex runs across the remaining 90% of the span. Compare on
+    # the pre-writer era only — the concurrent writer (t in [1000, 1050))
+    # keeps growing the log while we compact.
+    n_old_era = int(np.sum(log.freeze().column("time") <= 990))
+    assert n_old_era < n_initial // 2
+    for T, (vs, es) in want.items():
+        v = build_view(log, T)
+        assert _verts(v) == vs, T
+        assert _edges(v) == es, T
+    # the concurrent tail survived
+    v = build_view(log, 10**9)
+    assert any(e[0] in range(7) for e in _edges(v))
+
+
+def test_archivist_compressing_flag_gates_compression():
+    """Settings.compressing=False must skip the compress phase (history
+    with redundant runs keeps its events apart from the archived prefix)."""
+    def mk():
+        log = EventLog()
+        for t in range(0, 100):
+            log.add_vertex(t, 1)        # 100-event redundant run
+        log.add_edge(200, 1, 2)
+        return TemporalGraph(log)
+
+    g_off = mk()
+    Archivist(g_off, max_events=10, compressing=False,
+              archiving=True).maybe_compact()
+    g_on = mk()
+    Archivist(g_on, max_events=10, compressing=True,
+              archiving=True).maybe_compact()
+    # archive alone keeps the redundant run (it is after the 10% cutoff);
+    # with compression on, the run collapses to one event
+    assert g_on.log.n < g_off.log.n
+    for T in (50, 150, 250):
+        assert _verts(build_view(g_on.log, T)) == \
+            _verts(build_view(g_off.log, T)), T
+    # neither-phase governor is a no-op even over budget
+    g_none = mk()
+    n0 = g_none.log.n
+    assert not Archivist(g_none, max_events=10, compressing=False,
+                         archiving=False).maybe_compact()
+    assert g_none.log.n == n0
+
+
 def test_compact_to_preserves_concurrent_tail():
     """In-place compaction: events appended after the freeze survive, and all
     holders of the log object see the compacted history."""
@@ -211,3 +295,16 @@ def test_checkpoint_during_live_ingestion_is_consistent(tmp_path):
     finally:
         stop.set()
         t.join(2)
+
+
+def test_archivist_skips_splice_when_nothing_shrinks():
+    """Compress-only governor on incompressible history must not rewrite
+    the log (and churn caches) every tick."""
+    log = EventLog()
+    for t in range(50):            # alternating add/delete: nothing redundant
+        (log.add_vertex if t % 2 == 0 else log.delete_vertex)(t, 1)
+    g = TemporalGraph(log)
+    v_before = log.version
+    arch = Archivist(g, max_events=10, compressing=True, archiving=False)
+    assert not arch.maybe_compact()
+    assert log.version == v_before  # no splice happened
