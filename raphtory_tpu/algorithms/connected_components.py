@@ -29,6 +29,7 @@ class ConnectedComponents(VertexProgram):
     max_steps: int = 100
     combiner = "min"
     direction = "both"
+    reduce_shell_safe = True   # reducer reads vids/v_mask only
     needs_vids = False
     needs_vertex_times = False
     needs_edge_times = False

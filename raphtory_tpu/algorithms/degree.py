@@ -19,6 +19,7 @@ from ..engine.program import Context, VertexProgram
 @dataclass(frozen=True)
 class DegreeBasic(VertexProgram):
     max_steps: int = 0
+    reduce_shell_safe = True   # reducer reads vids/v_mask only
     needs_vids = False
     needs_vertex_times = False
     needs_edge_times = False

@@ -24,6 +24,7 @@ class PageRank(VertexProgram):
     max_steps: int = 50
     combiner = "sum"
     direction = "out"   # payload flows src→dst, combined at dst = pull at dst
+    reduce_shell_safe = True   # reducer reads vids/v_mask only
     needs_vids = False
     needs_vertex_times = False
     needs_edge_times = False

@@ -55,6 +55,51 @@ def supported(program: VertexProgram) -> bool:
             and not program.vertex_props)
 
 
+class GlobalTables:
+    """Static global-dense-space graph tables over a pinned log: every
+    vertex id the log ever mentions (rank in ``uv`` = dense index) and every
+    (src, dst) pair, (dst, src)-sorted. Positions never change across a
+    sweep — shared by the single-chip ``DeviceSweep`` and the mesh
+    ``parallel.sweep.ShardedSweep``."""
+
+    def __init__(self, sw: SweepBuilder):
+        if not sw._ok:
+            raise ValueError("log has >= 2^31 distinct vertices — the packed "
+                             "pair key space is exhausted; use build_view")
+        self.uv = sw.uv
+        is_e = (sw._k == EDGE_ADD) | (sw._k == EDGE_DELETE)
+        if is_e.any():
+            enc = (sw._dense(sw._s[is_e]) << _ENC_SHIFT) | sw._dense(sw._d[is_e])
+            self.all_enc = np.unique(enc)
+        else:
+            self.all_enc = np.empty(0, np.int64)
+
+        self.n = len(self.uv)
+        self.m = len(self.all_enc)
+        self.n_pad = _pad_bucket(self.n)
+        self.m_pad = _pad_bucket(self.m)
+
+        # engine edge order: (dst, src) — combine-at-destination segment ops
+        # run with indices_are_sorted=True (snapshot.py uses the same order)
+        flip = ((self.all_enc & _ENC_MASK) << _ENC_SHIFT) \
+            | (self.all_enc >> _ENC_SHIFT)
+        order = np.argsort(flip)              # engine pos i ← enc rank
+        self.eng_of_rank = np.empty(self.m, np.int64)
+        self.eng_of_rank[order] = np.arange(self.m)
+
+        self.e_src = np.full(self.m_pad, self.n_pad - 1, np.int32)
+        self.e_dst = np.full(self.m_pad, self.n_pad - 1, np.int32)
+        eng_enc = self.all_enc[order]
+        self.e_src[: self.m] = (eng_enc >> _ENC_SHIFT).astype(np.int32)
+        self.e_dst[: self.m] = (eng_enc & _ENC_MASK).astype(np.int32)
+        self.vids = np.full(self.n_pad, -1, np.int64)
+        self.vids[: self.n] = self.uv
+
+    def eng_pos(self, enc: np.ndarray) -> np.ndarray:
+        """Engine positions of packed pair keys (must exist in the log)."""
+        return self.eng_of_rank[np.searchsorted(self.all_enc, enc)]
+
+
 @functools.lru_cache(maxsize=32)
 def _compiled_apply(cap_v: int, cap_e: int):
     """Scatter one (padded) delta chunk into the six fold-state buffers.
@@ -109,43 +154,21 @@ class DeviceSweep:
 
     def __init__(self, log: EventLog):
         self.sw = SweepBuilder(log)
-        if not self.sw._ok:
-            raise ValueError("log has >= 2^31 distinct vertices — the packed "
-                             "pair key space is exhausted; use build_view")
-        sw = self.sw
-        self.uv = sw.uv
-        is_e = (sw._k == EDGE_ADD) | (sw._k == EDGE_DELETE)
-        if is_e.any():
-            enc = (sw._dense(sw._s[is_e]) << _ENC_SHIFT) | sw._dense(sw._d[is_e])
-            self.all_enc = np.unique(enc)
-        else:
-            self.all_enc = np.empty(0, np.int64)
+        self.tables = GlobalTables(self.sw)
+        t = self.tables
+        self.uv = t.uv
+        self.all_enc = t.all_enc
+        self.n, self.m = t.n, t.m
+        self.n_pad, self.m_pad = t.n_pad, t.m_pad
+        self._eng_of_rank = t.eng_of_rank
 
-        self.n = len(self.uv)
-        self.m = len(self.all_enc)
-        self.n_pad = _pad_bucket(self.n)
-        self.m_pad = _pad_bucket(self.m)
-
-        # engine edge order: (dst, src) — combine-at-destination segment ops
-        # run with indices_are_sorted=True (snapshot.py uses the same order)
-        flip = ((self.all_enc & _ENC_MASK) << _ENC_SHIFT) \
-            | (self.all_enc >> _ENC_SHIFT)
-        order = np.argsort(flip)                  # engine pos i ← enc rank
-        self._eng_of_rank = np.empty(self.m, np.int64)
-        self._eng_of_rank[order] = np.arange(self.m)
-
-        e_src = np.full(self.m_pad, self.n_pad - 1, np.int32)
-        e_dst = np.full(self.m_pad, self.n_pad - 1, np.int32)
-        eng_enc = self.all_enc[order]
-        e_src[: self.m] = (eng_enc >> _ENC_SHIFT).astype(np.int32)
-        e_dst[: self.m] = (eng_enc & _ENC_MASK).astype(np.int32)
-        vids = np.full(self.n_pad, -1, np.int64)
-        vids[: self.n] = self.uv
-
-        # static device uploads (once per sweep)
-        self.e_src = jnp.asarray(e_src)
-        self.e_dst = jnp.asarray(e_dst)
-        self.vids = jnp.asarray(vids)
+        # static device uploads (once per sweep); the host copies are not
+        # needed again on the single-chip path — free them rather than pin
+        # O(m_pad + n_pad) numpy for the sweep's lifetime
+        self.e_src = jnp.asarray(t.e_src)
+        self.e_dst = jnp.asarray(t.e_dst)
+        self.vids = jnp.asarray(t.vids)
+        t.e_src = t.e_dst = t.vids = None
 
         # fold-state buffers (donated through every delta application)
         tmin = jnp.full

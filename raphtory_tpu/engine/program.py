@@ -130,6 +130,14 @@ class VertexProgram:
     needs_vids: bool = True
     needs_vertex_times: bool = True
     needs_edge_times: bool = True
+    # True when the program's overridden ``reduce`` reads only the
+    # vertex-side view fields (vids / v_mask / v_latest_time /
+    # window_masks()[0]) — the amortised sweep engines hand reducers a
+    # lightweight shell without edge masks or property joins. Programs whose
+    # reducers touch edges or properties keep the default False and run on
+    # the full per-view path. (A non-overridden reduce is pass-through and
+    # always safe.)
+    reduce_shell_safe: bool = False
 
     # -- pure array functions --
 

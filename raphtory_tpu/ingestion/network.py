@@ -246,11 +246,11 @@ class MongoWindowSource(Source):
             hi = lo + self.window + 1
             count = 0
             for doc in coll.find_range(lo, hi):
-                try:
+                count += 1  # fetched docs count — a stretch of malformed
+                try:        # records must not read as "collection exhausted"
                     value = doc[self.field]
                 except (KeyError, TypeError):
                     continue  # "Cannot parse record" — skip, keep going
-                count += 1
                 yield value if isinstance(value, str) else json.dumps(value)
             lo += self.window
             if self.max_id is not None:

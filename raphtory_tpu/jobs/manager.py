@@ -101,17 +101,21 @@ class Job:
                 # (delta-applied snapshots, core/sweep.py) instead of
                 # re-folding the log per hop; otherwise hop-by-hop behind the
                 # watermark fence like the reference (RangeAnalysisTask).
-                sweep = None
-                if self.graph.safe_time() >= q.end:
-                    from ..core.sweep import SweepBuilder
+                # On a mesh, qualifying programs take the amortised path:
+                # static global-space partition + async dispatch overlap
+                # (parallel/sweep.py) instead of a fresh partition per hop.
+                if not self._try_range_mesh(q):
+                    sweep = None
+                    if self.graph.safe_time() >= q.end:
+                        from ..core.sweep import SweepBuilder
 
-                    sweep = SweepBuilder(
-                        self.graph.log,
-                        include_occurrences=self.program.needs_occurrences)
-                t = q.start
-                while t <= q.end and not self._kill.is_set():
-                    self._run_at(t, q, sweep=sweep)
-                    t += q.jump
+                        sweep = SweepBuilder(
+                            self.graph.log,
+                            include_occurrences=self.program.needs_occurrences)
+                    t = q.start
+                    while t <= q.end and not self._kill.is_set():
+                        self._run_at(t, q, sweep=sweep)
+                        t += q.jump
             elif isinstance(q, LiveQuery):
                 self._run_live(q)
             self.status = "done" if not self._kill.is_set() else "killed"
@@ -158,6 +162,72 @@ class Job:
                     break
             else:
                 self._kill.wait(q.repeat)
+
+    def _try_range_mesh(self, q: RangeQuery) -> bool:
+        """Amortised mesh range sweep: one static partition for the whole
+        range, per-hop O(delta) updates, hop i+1's host fold overlapped with
+        hop i's device supersteps (``sharded.run(block=False)``). Returns
+        False when the query/program must use the per-hop path."""
+        if self.mesh is None or self.graph.safe_time() < q.end:
+            return False
+        from ..engine.device_sweep import supported
+        from ..parallel import sharded as _sh
+        from ..parallel.sweep import ShardedSweep
+
+        if not supported(self.program):
+            return False
+        # the shell view handed to reducers has no edge masks or property
+        # joins — only pass-through reducers or ones declared shell-safe
+        # (vertex-side fields only) may take this path
+        if (type(self.program).reduce is not VertexProgram.reduce
+                and not self.program.reduce_shell_safe):
+            return False
+        try:
+            sweep = ShardedSweep(self.graph.log,
+                                 self.mesh.shape[_sh.V_AXIS])
+        except ValueError:
+            return False  # e.g. shard count does not divide the global pad
+        pending = None
+        t = q.start
+        while t <= q.end and not self._kill.is_set():
+            t0 = _time.perf_counter()
+            s0 = _time.perf_counter()
+            sweep.advance(int(t))
+            METRICS.snapshot_build_seconds.observe(_time.perf_counter() - s0)
+            windows = list(q.windows) if q.windows is not None else None
+            result, steps = sweep.run(
+                self.program, mesh=self.mesh, window=q.window,
+                windows=windows, block=False)
+            rv = sweep.reduce_view()
+            t_disp = _time.perf_counter()
+            if pending is not None:
+                self._emit_mesh(*pending)
+            pending = (t, q, rv, result, steps, t0, t_disp)
+            t += q.jump
+        if pending is not None:
+            self._emit_mesh(*pending)
+        return True
+
+    def _emit_mesh(self, t, q, rv, result, steps, t0, t_disp) -> None:
+        import jax
+        import numpy as np
+
+        # viewTime must mean "this hop's fold+dispatch + its device wait +
+        # reduce" — not the NEXT hop's host work that ran in the overlap gap.
+        # Shift t0 forward by the time spent between this hop's dispatch and
+        # now (the pipelined hop's fold) so _emit's end-to-end clock reads
+        # dispatch-window + blocking tail only.
+        t0 = t0 + (_time.perf_counter() - t_disp)
+        steps = int(steps)
+        METRICS.supersteps.inc(max(steps, 0))
+        if q.windows is not None:
+            for i, w in enumerate(q.windows):
+                r_i = jax.tree_util.tree_map(
+                    lambda a: np.asarray(a[i]), result)
+                self._emit(t, w, r_i, rv, steps, t0)
+        else:
+            result = jax.tree_util.tree_map(np.asarray, result)
+            self._emit(t, q.window, result, rv, steps, t0)
 
     def _run_at(self, t: int, q, exact: bool = True, sweep=None) -> None:
         t0 = _time.perf_counter()

@@ -498,7 +498,8 @@ def _sharded_runner(program: VertexProgram, mesh: Mesh, n_loc: int,
 
 def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
         window: int | None = None, windows=None,
-        sharded_view: ShardedView | None = None, comm: str = "auto"):
+        sharded_view: ShardedView | None = None, comm: str = "auto",
+        block: bool = True):
     """Run a vertex program SPMD over the mesh. Same surface as
     ``engine.bsp.run`` plus the mesh. Returns (result, steps) with result
     leading axes [K windows, n_pad] in GLOBAL vertex order.
@@ -507,7 +508,12 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
     the state along the vertex axis each superstep, ``"halo"`` exchanges only
     the remote rows each shard's edges reference (one all_to_all), and
     ``"auto"`` (default) picks halo whenever its measured exchange volume is
-    smaller."""
+    smaller.
+
+    ``block=False`` returns device arrays without waiting (steps stays a
+    device scalar) so a range sweep can overlap the next hop's host fold
+    with this hop's supersteps — the mesh twin of ``bsp.run_async``.
+    Multi-process runs always block (results must allgather to hosts)."""
     batched = windows is not None
     occurrences = bool(getattr(program, "needs_occurrences", False))
     if program.combiner == "custom" and program.direction == "both":
@@ -612,11 +618,13 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
         from jax.experimental import multihost_utils
 
         result = multihost_utils.process_allgather(result, tiled=True)
+        block = True
     # merge shard axis back into global vertex order: [K, S, n_loc] -> [K, n]
+    to_host = np.asarray if block else (lambda a: a)
     result = jax.tree_util.tree_map(
-        lambda a: np.asarray(a).reshape((k_pad, view.n_pad) + a.shape[3:]),
+        lambda a: to_host(a).reshape((k_pad, view.n_pad) + a.shape[3:]),
         result)
     result = jax.tree_util.tree_map(lambda a: a[:k], result)
     if not batched:
         result = jax.tree_util.tree_map(lambda a: a[0], result)
-    return result, int(steps)
+    return result, (int(steps) if block else steps)

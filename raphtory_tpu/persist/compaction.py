@@ -267,11 +267,9 @@ class Archivist:
         if self.compressing:
             c_cut = log.min_time + int(span * self.compress_fraction)
             new_log = compress_events(new_log, c_cut)
-            METRICS.compactions.labels("compress").inc()
         if self.archiving:
             a_cut = log.min_time + int(span * self.archive_fraction) + 1
             new_log = archive_events(new_log, a_cut)
-            METRICS.compactions.labels("archive").inc()
         if new_log.n >= frozen.n:
             # nothing shrank (e.g. compress-only on already-compressed
             # history) — skip the splice, or every governor tick would
@@ -279,5 +277,10 @@ class Archivist:
             return False
         log.compact_to(new_log, since_row=frozen.n)
         self.graph.invalidate_cache()
+        # counters record compactions that actually landed
+        if self.compressing:
+            METRICS.compactions.labels("compress").inc()
+        if self.archiving:
+            METRICS.compactions.labels("archive").inc()
         METRICS.compaction_seconds.observe(_time.perf_counter() - t0)
         return True
