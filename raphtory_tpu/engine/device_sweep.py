@@ -55,6 +55,16 @@ def supported(program: VertexProgram) -> bool:
             and not program.vertex_props)
 
 
+def _pad_large(n: int) -> int:
+    """Power-of-two buckets up to 2^16 (compile reuse across small logs),
+    then 2^16-multiples — pow2 padding would waste up to 2x of every
+    per-edge gather at GAB scale and beyond."""
+    if n <= (1 << 16):
+        return _pad_bucket(n)
+    step = 1 << 16
+    return ((n + step - 1) // step) * step
+
+
 class GlobalTables:
     """Static global-dense-space graph tables over a pinned log: every
     vertex id the log ever mentions (rank in ``uv`` = dense index) and every
@@ -76,8 +86,18 @@ class GlobalTables:
 
         self.n = len(self.uv)
         self.m = len(self.all_enc)
-        self.n_pad = _pad_bucket(self.n)
-        self.m_pad = _pad_bucket(self.m)
+        self.n_pad = _pad_large(self.n)
+        self.m_pad = _pad_large(self.m)
+        # times narrow to i32 when the whole log fits — halves both the
+        # resident fold state and the delta bytes, and skips the TPU's
+        # emulated 64-bit compares in the per-hop window masks
+        tcol = sw._t
+        self.tdtype = (
+            np.int32 if len(tcol) == 0
+            or (tcol.min() > np.iinfo(np.int32).min // 2
+                and tcol.max() < np.iinfo(np.int32).max // 2)
+            else np.int64)
+        self.tmin = np.iinfo(self.tdtype).min
 
         # engine edge order: (dst, src) — combine-at-destination segment ops
         # run with indices_are_sorted=True (snapshot.py uses the same order)
@@ -101,7 +121,7 @@ class GlobalTables:
 
 
 @functools.lru_cache(maxsize=32)
-def _compiled_apply(cap_v: int, cap_e: int):
+def _compiled_apply(cap_v: int, cap_e: int, tdt: str):
     """Scatter one (padded) delta chunk into the six fold-state buffers.
     Chunk capacities are fixed per sweep, so this compiles exactly once;
     pad rows carry index -1 and are dropped by the scatter."""
@@ -121,18 +141,34 @@ def _compiled_apply(cap_v: int, cap_e: int):
 
 
 @functools.lru_cache(maxsize=256)
-def _compiled_run(program: VertexProgram, n: int, m: int, k: int):
+def _compiled_run(program: VertexProgram, n: int, m: int, k: int, tdt: str):
     """Mask-compute + superstep program over the resident fold state —
     one compile per (program, shapes, #windows), shared across hops AND
     across DeviceSweep instances of the same padded size."""
     core = make_mask_runner(program, n, m, k)
+    tdt = jnp.dtype(tdt)
 
     def run(v_lat, v_alive, v_first, e_lat, e_alive, e_first,
             vids, e_src, e_dst, time, windows):
-        lo = (time - windows)[:, None]            # i64[k, 1]
+        # window-mask compares run in the narrow time dtype: the resident
+        # lat values fit it by construction, and lo clamps into range (a
+        # clamped lo only widens the window past every real timestamp)
+        info = jnp.iinfo(tdt)
+        lo = jnp.clip(time - windows, info.min, info.max).astype(tdt)[:, None]
         nowin = (windows < 0)[:, None]
         v_masks = v_alive[None, :] & (nowin | (v_lat[None, :] >= lo))
         e_masks = e_alive[None, :] & (nowin | (e_lat[None, :] >= lo))
+        # the Edges/Context contract is i64 times; only widen when the
+        # program actually reads them (pad slots map to INT64_MIN exactly)
+        def widen(a):
+            if a.dtype == jnp.int64:
+                return a
+            return jnp.where(a == info.min, jnp.iinfo(jnp.int64).min,
+                             a.astype(jnp.int64))
+        if program.needs_vertex_times:
+            v_lat, v_first = widen(v_lat), widen(v_first)
+        if program.needs_edge_times:
+            e_lat, e_first = widen(e_lat), widen(e_first)
         return core(v_masks, e_masks, vids, v_lat, v_first,
                     e_src, e_dst, e_lat, e_first, time, windows, {}, {})
 
@@ -170,15 +206,18 @@ class DeviceSweep:
         self.vids = jnp.asarray(t.vids)
         t.e_src = t.e_dst = t.vids = None
 
-        # fold-state buffers (donated through every delta application)
-        tmin = jnp.full
+        # fold-state buffers (donated through every delta application), in
+        # the narrow time dtype the log fits (tables.tdtype)
+        self.tdtype = t.tdtype
+        self._tmin = t.tmin
+        tdt = jnp.dtype(self.tdtype)
         self._bufs = (
-            tmin((self.n_pad,), INT64_MIN, jnp.int64),   # v_lat
+            jnp.full((self.n_pad,), self._tmin, tdt),    # v_lat
             jnp.zeros((self.n_pad,), bool),              # v_alive
-            tmin((self.n_pad,), INT64_MIN, jnp.int64),   # v_first
-            tmin((self.m_pad,), INT64_MIN, jnp.int64),   # e_lat
+            jnp.full((self.n_pad,), self._tmin, tdt),    # v_first
+            jnp.full((self.m_pad,), self._tmin, tdt),    # e_lat
             jnp.zeros((self.m_pad,), bool),              # e_alive
-            tmin((self.m_pad,), INT64_MIN, jnp.int64),   # e_first
+            jnp.full((self.m_pad,), self._tmin, tdt),    # e_first
         )
         # delta chunk capacities: big enough that a typical hop is one chunk,
         # fixed so the scatter program compiles exactly once per sweep shape
@@ -226,6 +265,12 @@ class DeviceSweep:
                 d["e_first"][oe: oe + self.cap_e],
             )
 
+    def _cast_t(self, a: np.ndarray) -> np.ndarray:
+        """i64 fold times → the resident dtype (INT64_MIN pad → its min)."""
+        if self.tdtype == np.int64:
+            return a
+        return np.where(a == INT64_MIN, self._tmin, a).astype(self.tdtype)
+
     def _apply_chunk(self, v_idx, v_lat, v_alive, v_first,
                      e_idx, e_lat, e_alive, e_first) -> None:
         def pad(a, cap, dtype):
@@ -235,33 +280,35 @@ class DeviceSweep:
             out[: len(a)] = a
             return out
 
-        self._bufs = _compiled_apply(self.cap_v, self.cap_e)(
+        tdt = self.tdtype
+        self._bufs = _compiled_apply(self.cap_v, self.cap_e, np.dtype(tdt).name)(
             *self._bufs,
             jnp.asarray(pad(v_idx, self.cap_v, np.int32)),
-            jnp.asarray(pad(v_lat, self.cap_v, np.int64)),
+            jnp.asarray(pad(self._cast_t(v_lat), self.cap_v, tdt)),
             jnp.asarray(pad(v_alive, self.cap_v, bool)),
-            jnp.asarray(pad(v_first, self.cap_v, np.int64)),
+            jnp.asarray(pad(self._cast_t(v_first), self.cap_v, tdt)),
             jnp.asarray(pad(e_idx, self.cap_e, np.int32)),
-            jnp.asarray(pad(e_lat, self.cap_e, np.int64)),
+            jnp.asarray(pad(self._cast_t(e_lat), self.cap_e, tdt)),
             jnp.asarray(pad(e_alive, self.cap_e, bool)),
-            jnp.asarray(pad(e_first, self.cap_e, np.int64)),
+            jnp.asarray(pad(self._cast_t(e_first), self.cap_e, tdt)),
         )
 
     def _refresh_full(self) -> None:
         sw = self.sw
-        v_lat = np.full(self.n_pad, INT64_MIN, np.int64)
+        tdt = self.tdtype
+        v_lat = np.full(self.n_pad, self._tmin, tdt)
         v_alive = np.zeros(self.n_pad, bool)
-        v_first = np.full(self.n_pad, INT64_MIN, np.int64)
-        v_lat[: self.n] = sw.v_lat
+        v_first = np.full(self.n_pad, self._tmin, tdt)
+        v_lat[: self.n] = self._cast_t(sw.v_lat)
         v_alive[: self.n] = sw.v_alive
-        v_first[: self.n] = sw.v_first
-        e_lat = np.full(self.m_pad, INT64_MIN, np.int64)
+        v_first[: self.n] = self._cast_t(sw.v_first)
+        e_lat = np.full(self.m_pad, self._tmin, tdt)
         e_alive = np.zeros(self.m_pad, bool)
-        e_first = np.full(self.m_pad, INT64_MIN, np.int64)
+        e_first = np.full(self.m_pad, self._tmin, tdt)
         pos = self._eng_of_rank[np.searchsorted(self.all_enc, sw.e_enc)]
-        e_lat[pos] = sw.e_lat
+        e_lat[pos] = self._cast_t(sw.e_lat)
         e_alive[pos] = sw.e_alive
-        e_first[pos] = sw.e_first
+        e_first[pos] = self._cast_t(sw.e_first)
         self._bufs = tuple(jnp.asarray(a) for a in
                            (v_lat, v_alive, v_first, e_lat, e_alive, e_first))
 
@@ -286,7 +333,8 @@ class DeviceSweep:
             windows = [window if window is not None else -1]
         wlist = [(-1 if w is None else int(w)) for w in windows]
 
-        runner = _compiled_run(program, self.n_pad, self.m_pad, len(wlist))
+        runner = _compiled_run(program, self.n_pad, self.m_pad, len(wlist),
+                               np.dtype(self.tdtype).name)
         result, steps = runner(
             *self._bufs, self.vids, self.e_src, self.e_dst,
             jnp.asarray(self.t_now, jnp.int64),

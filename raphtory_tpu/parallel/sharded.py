@@ -166,6 +166,11 @@ def _build_halo(idx_g: np.ndarray, n_loc: int, S: int):
     return h, idx_h, send
 
 
+# build counter — the amortisation witness: range sweeps that re-partition
+# per hop (the round-3 regression class) show up as increments here
+PARTITION_BUILDS = 0
+
+
 def partition_view(view: GraphView, n_shards: int,
                    edge_props: tuple = (),
                    occurrences: bool = False) -> ShardedView:
@@ -178,6 +183,8 @@ def partition_view(view: GraphView, n_shards: int,
         f"vertex shard count {n_shards} must divide the padded vertex count "
         f"{view.n_pad} (pad buckets are powers of two; use a power-of-two "
         f"vertex-axis size)")
+    global PARTITION_BUILDS
+    PARTITION_BUILDS += 1
     n_loc = view.n_pad // n_shards
     S = n_shards
 

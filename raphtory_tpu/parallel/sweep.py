@@ -48,6 +48,7 @@ class ShardedSweep:
                 f"vertex count ({t.n_pad})")
         S = self.S = n_shards
         n_loc = self.n_loc = t.n_pad // n_shards
+        sharded.PARTITION_BUILDS += 1   # the ONE static build of this sweep
 
         # ---- static partition of the global pair table (both directions) --
         def build(owner_of, local_of, global_of):
@@ -107,7 +108,6 @@ class ShardedSweep:
                              v_first_time=self.sv.v_first.reshape(-1))
         self.sv.view = self._shell
         self.t_now: int | None = None
-        self.partitions_built = 1   # amortisation witness for tests/benches
 
     # ---- sweep driving ----
 

@@ -127,3 +127,19 @@ def gab_like_log(
     log = EventLog()
     log.append_batch(times, kinds, src, dst)
     return log
+
+
+def twitter_like_log(
+    n_vertices: int = 5_300_000,
+    n_edges: int = 100_000_000,
+    seed: int = 11,
+    t_span: int = 2_600_000,
+) -> EventLog:
+    """Twitter-2010-class synthetic follow graph (the BASELINE.md scale
+    config shape): tens of millions of preferential-attachment edges over a
+    month of timestamps. Same generator as ``gab_like_log`` — heavy-tailed
+    degrees, one giant component — at a scale where the vertex state stops
+    fitting any host cache and the accelerator's memory system is the
+    ceiling."""
+    return gab_like_log(n_vertices=n_vertices, n_edges=n_edges, seed=seed,
+                        t_span=t_span)

@@ -116,6 +116,31 @@ def test_times_must_ascend_and_repeat_ok():
         ds.advance(10)
 
 
+def test_wide_timestamps_use_i64_path():
+    """Times beyond int32 keep the resident state in i64 and still match
+    the per-view path (the narrow-dtype optimisation must be semantics-free
+    in both modes)."""
+    from raphtory_tpu.core.events import EventLog
+
+    base = 3_000_000_000  # > int32 max
+    log = EventLog()
+    log.add_edge(base + 10, 1, 2)
+    log.add_edge(base + 20, 2, 3)
+    log.add_edge(base + 500, 3, 1)
+    ds = DeviceSweep(log)
+    assert ds.tdtype == np.int64
+    pr = PageRank(max_steps=10, tol=1e-8)
+    for T in (base + 15, base + 600):
+        got, _ = ds.run(pr, T, windows=[1000, 8])
+        view = build_view(log, T)
+        want, _ = bsp.run(pr, view, windows=[1000, 8])
+        for i in range(2):
+            vd = _view_dict(view, want[i], window=[1000, 8][i])
+            dd = _dev_dict(ds, got[i], vd.keys())
+            for vid in vd:
+                assert vd[vid] == pytest.approx(dd[vid], abs=1e-6)
+
+
 def test_empty_log_and_pre_history_time():
     from raphtory_tpu.core.events import EventLog
 
