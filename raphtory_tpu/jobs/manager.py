@@ -101,10 +101,12 @@ class Job:
                 # (delta-applied snapshots, core/sweep.py) instead of
                 # re-folding the log per hop; otherwise hop-by-hop behind the
                 # watermark fence like the reference (RangeAnalysisTask).
-                # On a mesh, qualifying programs take the amortised path:
-                # static global-space partition + async dispatch overlap
-                # (parallel/sweep.py) instead of a fresh partition per hop.
-                if not self._try_range_mesh(q):
+                # Qualifying programs take the amortised engines: on a mesh
+                # the static global-space partition (parallel/sweep.py), on
+                # one device the device-resident sweep (engine/device_sweep)
+                # — fold state stays on the chip, hops ship O(delta) bytes.
+                if not (self._try_range_mesh(q)
+                        or self._try_range_device(q)):
                     sweep = None
                     if self.graph.safe_time() >= q.end:
                         from ..core.sweep import SweepBuilder
@@ -208,6 +210,43 @@ class Job:
             self._emit_mesh(*pending)
         return True
 
+    def _try_range_device(self, q: RangeQuery) -> bool:
+        """Single-device amortised range sweep: device-resident fold state,
+        O(delta) per-hop uploads, pipelined emit (engine/device_sweep)."""
+        if self.mesh is not None or self.graph.safe_time() < q.end:
+            return False
+        from ..engine.device_sweep import DeviceSweep, supported
+
+        if not supported(self.program):
+            return False
+        if (type(self.program).reduce is not VertexProgram.reduce
+                and not self.program.reduce_shell_safe):
+            return False
+        try:
+            sweep = DeviceSweep(self.graph.log)
+        except ValueError:
+            return False  # >2^31 distinct vertices: packed keys exhausted
+        shell = _DeviceShell(sweep)
+        pending = None
+        t = q.start
+        while t <= q.end and not self._kill.is_set():
+            t0 = _time.perf_counter()
+            s0 = _time.perf_counter()
+            sweep.advance(int(t))
+            METRICS.snapshot_build_seconds.observe(_time.perf_counter() - s0)
+            windows = list(q.windows) if q.windows is not None else None
+            result, steps = sweep.run(
+                self.program, window=q.window, windows=windows)
+            rv = shell.freeze()
+            t_disp = _time.perf_counter()
+            if pending is not None:
+                self._emit_mesh(*pending)
+            pending = (t, q, rv, result, steps, t0, t_disp)
+            t += q.jump
+        if pending is not None:
+            self._emit_mesh(*pending)
+        return True
+
     def _emit_mesh(self, t, q, rv, result, steps, t0, t_disp) -> None:
         import jax
         import numpy as np
@@ -278,6 +317,33 @@ class Job:
             "result": reduced,
         }
         self.results.append(row)
+
+
+class _DeviceShell:
+    """Reducer-facing view shells over a DeviceSweep's HOST fold state
+    (the device buffers' numpy twin lives in the SweepBuilder)."""
+
+    def __init__(self, sweep):
+        self.sweep = sweep
+
+    def freeze(self):
+        import numpy as np
+
+        from ..core.snapshot import INT64_MIN
+        from ..parallel.sweep import _Shell
+
+        ds = self.sweep
+        n, n_pad = ds.n, ds.n_pad
+        vids = np.full(n_pad, -1, np.int64)
+        vids[:n] = ds.uv
+        vm = np.zeros(n_pad, bool)
+        vm[:n] = ds.sw.v_alive
+        vl = np.full(n_pad, INT64_MIN, np.int64)
+        vl[:n] = ds.sw.v_lat
+        vf = np.full(n_pad, INT64_MIN, np.int64)
+        vf[:n] = ds.sw.v_first
+        return _Shell(time=int(ds.t_now), n_pad=n_pad, vids=vids, v_mask=vm,
+                      v_latest_time=vl, v_first_time=vf)
 
 
 class AnalysisManager:

@@ -208,3 +208,34 @@ def test_rest_dynamic_analyser(server):
 def test_registry_lists_builtins():
     ns = registry.names()
     assert {"ConnectedComponents", "PageRank", "DegreeBasic"} <= set(ns)
+
+
+def test_single_device_range_uses_device_sweep_and_matches():
+    """Without a mesh, qualifying Range queries run on the device-resident
+    sweep; results must match the per-view path exactly (per-vid)."""
+    import numpy as np
+
+    from raphtory_tpu.algorithms import ConnectedComponents
+    from raphtory_tpu.core.service import TemporalGraph
+    from raphtory_tpu.core.snapshot import build_view
+    from raphtory_tpu.engine import bsp
+    from raphtory_tpu.jobs.manager import AnalysisManager, RangeQuery
+
+    rng = np.random.default_rng(12)
+    from test_sweep import random_log
+
+    log = random_log(rng, n_events=400, n_ids=30, t_span=60)
+    g = TemporalGraph(log)
+    mgr = AnalysisManager(g)          # no mesh
+    cc = ConnectedComponents(max_steps=40)
+    job = mgr.submit(cc, RangeQuery(start=20, end=60, jump=20, window=30))
+    assert job.wait(120), job.error
+    assert job.status == "done", job.error
+    assert len(job.results) == 3
+    for row in job.results:
+        view = g.view_at(row["time"], exact=False)
+        want, _ = bsp.run(cc, view, window=30)
+        expect = cc.reduce(want, view, window=30)
+        assert row["result"]["vertices"] == expect["vertices"], row["time"]
+        assert row["result"]["clusters"] == expect["clusters"], row["time"]
+        assert row["result"]["top5"] == expect["top5"], row["time"]
