@@ -58,13 +58,10 @@ class TemporalGraph:
         every 10 s — AnalysisTask.scala:183-189); exact=False serves a
         best-effort live view."""
         if exact:
-            deadline = _time.monotonic() + wait_timeout
-            while self.safe_time() < time:
-                if _time.monotonic() >= deadline:
-                    raise StaleViewError(
-                        f"view at {time} not yet safe: watermark="
-                        f"{self.safe_time()} ({self.watermarks.snapshot()})")
-                _time.sleep(min(0.05, wait_timeout))
+            if not self.watermarks.wait_for(time, timeout=wait_timeout):
+                raise StaleViewError(
+                    f"view at {time} not yet safe: watermark="
+                    f"{self.safe_time()} ({self.watermarks.snapshot()})")
         version = self.log.version
         key = (version, int(time), include_occurrences)
         with self._cache_lock:

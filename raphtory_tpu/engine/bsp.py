@@ -101,11 +101,15 @@ def make_mask_runner(program: VertexProgram, n: int, m: int, k: int):
                 (k * m,) + a.shape[1:])
 
         def combine_flat(tree_flat, ids, sorted_):
+            # the segmented-scan combine beats XLA's scatter lowering ~3x
+            # per element on TPU but is a multi-pass loser on CPU (whose
+            # native scatter-add is one pass) — pick per backend at trace
+            # time; per-window blocks keep results bitwise equal to k=1 runs
+            use_scan = (program.combiner == "sum" and sorted_
+                        and jax.default_backend() == "tpu")
+
             def leaf(x):
-                if sorted_ and program.combiner == "sum":
-                    # hot path: prefix-scan + CSR boundary diff beats the
-                    # scatter lowering ~3x per element on TPU; per-window
-                    # blocks keep results bitwise equal to k=1 runs
+                if use_scan:
                     out = segment_sum_sorted_csr(x, ids, k * n, em_flat,
                                                  block_size=m)
                 else:

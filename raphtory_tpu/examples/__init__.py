@@ -12,6 +12,9 @@ analysers built on the core algorithm library.
 | ``examples/citationNetwork`` | :mod:`.citations` |
 | ``examples/trackAndTrace``   | :mod:`.track_and_trace` |
 | ``examples/twitterRumour``   | :mod:`.twitter_rumour` |
+
+Plus :mod:`.embeddings` — temporal vertex embeddings over windowed feature
+propagation, a workload class the reference has no analogue for.
 """
 
 from .blockchain import (
@@ -22,6 +25,7 @@ from .blockchain import (
     EthereumTransactionParser,
 )
 from .citations import CitationParser
+from .embeddings import TemporalEmbeddings
 from .gab import GabMostUsedTopics, GabPostGraphParser, GabUserGraphParser
 from .ldbc import LDBCParser
 from .random_graph import RandomCommandSource, RandomJsonParser

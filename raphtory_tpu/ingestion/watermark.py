@@ -24,6 +24,7 @@ _NEG_INF = -(2**62)
 class WatermarkRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._marks: dict[str, int] = {}
         self._done: set[str] = set()
 
@@ -37,18 +38,32 @@ class WatermarkRegistry:
             if watermark > cur:
                 self._marks[source] = watermark
             self._gauge_locked()
+            self._cond.notify_all()
 
     def finish(self, source: str) -> None:
         """Source exhausted: it can never hold the fence back again."""
         with self._lock:
             self._done.add(source)
             self._gauge_locked()
+            self._cond.notify_all()
+
+    def wait_for(self, time: int, timeout: float | None = None) -> bool:
+        """Block until ``safe_time() >= time`` (True) or timeout (False) —
+        the condition-variable fence wait that replaces the reference's
+        10-second recheck loop (``AnalysisTask.scala:183-189``) and this
+        package's earlier 50 ms polling."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: self._safe_locked() >= time, timeout)
+
+    def _safe_locked(self) -> int:
+        live = [w for s, w in self._marks.items() if s not in self._done]
+        return min(live) if live else 2**62
 
     def _gauge_locked(self) -> None:
         # compute-and-set under _lock: a preempted thread must not clobber a
         # newer safe_time with a stale lower one
-        live = [w for s, w in self._marks.items() if s not in self._done]
-        t = min(live) if live else 2**62
+        t = self._safe_locked()
         if abs(t) < 2**62:  # only meaningful mid-stream values
             METRICS.watermark.set(t)
 
@@ -56,10 +71,7 @@ class WatermarkRegistry:
         """Largest T such that every live source has promised no more events
         at or before T. +inf (2^62) if all sources finished."""
         with self._lock:
-            live = [w for s, w in self._marks.items() if s not in self._done]
-            if not live:
-                return 2**62
-            return min(live)
+            return self._safe_locked()
 
     def snapshot(self) -> dict[str, int]:
         with self._lock:

@@ -141,11 +141,16 @@ class Job:
                     # catch up (never clamped back: LiveAnalysisTask.scala:
                     # 34-52 event-time mode); sub-1 repeats still advance
                     t_target += max(1, int(q.repeat))
+                # condition-variable fence wait (chunked so kill() still
+                # interrupts promptly even with no watermark traffic)
                 deadline = _time.monotonic() + self.wait_timeout
-                while (self.graph.safe_time() < t_target
-                       and not self._kill.is_set()
-                       and _time.monotonic() < deadline):
-                    _time.sleep(0.05)
+                while (not self._kill.is_set()
+                       and _time.monotonic() < deadline
+                       and not self.graph.watermarks.wait_for(
+                           t_target,
+                           timeout=min(0.5, max(
+                               0.0, deadline - _time.monotonic())))):
+                    pass
                 t = t_target
             else:
                 t = min(self.graph.safe_time(), self.graph.latest_time)
@@ -189,25 +194,12 @@ class Job:
                                  self.mesh.shape[_sh.V_AXIS])
         except ValueError:
             return False  # e.g. shard count does not divide the global pad
-        pending = None
-        t = q.start
-        while t <= q.end and not self._kill.is_set():
-            t0 = _time.perf_counter()
-            s0 = _time.perf_counter()
-            sweep.advance(int(t))
-            METRICS.snapshot_build_seconds.observe(_time.perf_counter() - s0)
-            windows = list(q.windows) if q.windows is not None else None
-            result, steps = sweep.run(
-                self.program, mesh=self.mesh, window=q.window,
-                windows=windows, block=False)
-            rv = sweep.reduce_view()
-            t_disp = _time.perf_counter()
-            if pending is not None:
-                self._emit_mesh(*pending)
-            pending = (t, q, rv, result, steps, t0, t_disp)
-            t += q.jump
-        if pending is not None:
-            self._emit_mesh(*pending)
+
+        def run(windows):
+            return sweep.run(self.program, mesh=self.mesh, window=q.window,
+                             windows=windows, block=False)
+
+        self._range_amortised(q, sweep.advance, run, sweep.reduce_view)
         return True
 
     def _try_range_device(self, q: RangeQuery) -> bool:
@@ -227,17 +219,27 @@ class Job:
         except ValueError:
             return False  # >2^31 distinct vertices: packed keys exhausted
         shell = _DeviceShell(sweep)
+
+        def run(windows):
+            return sweep.run(self.program, window=q.window, windows=windows)
+
+        self._range_amortised(q, sweep.advance, run, shell.freeze)
+        return True
+
+    def _range_amortised(self, q: RangeQuery, advance, run, freeze_rv) -> None:
+        """The shared amortised-sweep hop loop: advance the fold, dispatch
+        async, emit the PREVIOUS hop while this one computes (hop i+1's host
+        fold overlaps hop i's device supersteps)."""
         pending = None
         t = q.start
         while t <= q.end and not self._kill.is_set():
             t0 = _time.perf_counter()
             s0 = _time.perf_counter()
-            sweep.advance(int(t))
+            advance(int(t))
             METRICS.snapshot_build_seconds.observe(_time.perf_counter() - s0)
             windows = list(q.windows) if q.windows is not None else None
-            result, steps = sweep.run(
-                self.program, window=q.window, windows=windows)
-            rv = shell.freeze()
+            result, steps = run(windows)
+            rv = freeze_rv()
             t_disp = _time.perf_counter()
             if pending is not None:
                 self._emit_mesh(*pending)
@@ -245,7 +247,6 @@ class Job:
             t += q.jump
         if pending is not None:
             self._emit_mesh(*pending)
-        return True
 
     def _emit_mesh(self, t, q, rv, result, steps, t0, t_disp) -> None:
         import jax

@@ -156,3 +156,45 @@ def test_assign_id_stability():
     assert a1 == assign_id("alice")
     assert a1 != assign_id("bob")
     assert assign_id(42) == 42
+
+
+def test_watermark_wait_for_wakes_on_advance():
+    """The fence wait is event-driven: a waiter parked on wait_for(T) wakes
+    as soon as the watermark crosses T — far faster than a polling loop —
+    and times out cleanly when it never does."""
+    import threading
+    import time as _t
+
+    from raphtory_tpu.ingestion.watermark import WatermarkRegistry
+
+    wm = WatermarkRegistry()
+    wm.register("s")
+    assert not wm.wait_for(100, timeout=0.05)  # times out, fence not crossed
+
+    woke = {}
+
+    def waiter():
+        t0 = _t.perf_counter()
+        ok = wm.wait_for(100, timeout=5.0)
+        woke["ok"] = ok
+        woke["latency"] = _t.perf_counter() - t0
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    _t.sleep(0.1)
+    t_adv = _t.perf_counter()
+    wm.advance("s", 150)
+    th.join(2.0)
+    assert woke["ok"]
+    # woke promptly after advance (well before the 5 s timeout would fire);
+    # no lower bound — a slow-to-schedule waiter may observe the fence
+    # already crossed, which is also correct
+    assert _t.perf_counter() - t_adv < 0.5
+    # finish() also releases waiters (safe_time -> +inf)
+    wm2 = WatermarkRegistry()
+    wm2.register("x")
+    th2 = threading.Thread(target=lambda: wm2.wait_for(10**9, timeout=5.0))
+    th2.start()
+    wm2.finish("x")
+    th2.join(1.0)
+    assert not th2.is_alive()
