@@ -112,14 +112,33 @@ def _range_sweep(programs, log, view_times, windows):
 
     Programs the device-resident engine supports run on it (fold state lives
     on the chip; each hop ships only O(delta) bytes — engine/device_sweep.py);
-    the rest use the host snapshot path with async dispatch overlap."""
+    the rest use the host snapshot path with async dispatch overlap. Mixed
+    lists split into one pass per engine and report combined throughput."""
     from raphtory_tpu.engine.device_sweep import supported
 
     if not isinstance(programs, (list, tuple)):
         programs = [programs]
-    if all(supported(p) for p in programs):
-        return _range_sweep_device(programs, log, view_times, windows)
-    return _range_sweep_host(programs, log, view_times, windows)
+    dev = [p for p in programs if supported(p)]
+    host = [p for p in programs if not supported(p)]
+    parts = []
+    if dev:
+        parts.append(_range_sweep_device(dev, log, view_times, windows))
+    if host:
+        parts.append(_range_sweep_host(host, log, view_times, windows))
+    if len(parts) == 1:
+        return parts[0]
+    n_views = sum(d["n_views"] for _, d in parts)
+    secs = sum(d["sweep_seconds"] for _, d in parts)
+    detail = {
+        "n_views": n_views,
+        "engine": "+".join(d["engine"] for _, d in parts),
+        "sweep_seconds": round(secs, 3),
+        "snapshot_build_seconds": round(
+            sum(d["snapshot_build_seconds"] for _, d in parts), 3),
+        "overlap_compute_seconds": round(
+            sum(d["overlap_compute_seconds"] for _, d in parts), 3),
+    }
+    return n_views / secs, detail
 
 
 def _range_sweep_device(programs, log, view_times, windows):

@@ -60,6 +60,25 @@ got, steps = sharded.run(pr, view, mesh, windows=[100, 20])
 with jax.default_device(jax.local_devices()[0]):
     want, _ = bsp.run(pr, view, windows=[100, 20])
 np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+# amortised mesh range sweep across BOTH processes: static global-space
+# partition, per-hop deltas, every host sees the allgathered result
+from raphtory_tpu.parallel.sweep import ShardedSweep
+
+sweep = ShardedSweep(log, mesh.shape[sharded.V_AXIS])
+for T in (50, 75, 100):
+    got_s, _ = sweep.run(pr, T, mesh=mesh, windows=[100, 20])
+    view_t = build_view(log, T)
+    with jax.default_device(jax.local_devices()[0]):
+        want_t, _ = bsp.run(pr, view_t, windows=[100, 20])
+    # compare per-vid (sweep rows are the global dense space)
+    for i, vid in enumerate(view_t.vids):
+        if not view_t.v_mask[i]:
+            continue
+        p = int(np.searchsorted(sweep.t.uv, vid))
+        assert abs(float(np.asarray(want_t)[0, i])
+                   - float(np.asarray(got_s)[0, p])) < 1e-5, (T, int(vid))
+
 print(f"proc {pid} ok steps={int(steps)}", flush=True)
 '''
 
