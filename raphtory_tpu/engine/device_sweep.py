@@ -119,6 +119,18 @@ class GlobalTables:
         """Engine positions of packed pair keys (must exist in the log)."""
         return self.eng_of_rank[np.searchsorted(self.all_enc, enc)]
 
+    def cast_times(self, a: np.ndarray) -> np.ndarray:
+        """i64 fold times → the narrow resident dtype (INT64_MIN pad maps to
+        the narrow dtype's min) — shared by every engine over these tables."""
+        if self.tdtype == np.int64:
+            return a
+        return np.where(a == INT64_MIN, self.tmin, a).astype(self.tdtype)
+
+
+def normalize_windows(windows) -> list[int]:
+    """window list → int list with -1 for 'no window' (engine convention)."""
+    return [(-1 if w is None else int(w)) for w in windows]
+
 
 @functools.lru_cache(maxsize=32)
 def _compiled_apply(cap_v: int, cap_e: int, tdt: str):
@@ -266,10 +278,7 @@ class DeviceSweep:
             )
 
     def _cast_t(self, a: np.ndarray) -> np.ndarray:
-        """i64 fold times → the resident dtype (INT64_MIN pad → its min)."""
-        if self.tdtype == np.int64:
-            return a
-        return np.where(a == INT64_MIN, self._tmin, a).astype(self.tdtype)
+        return self.tables.cast_times(a)
 
     def _apply_chunk(self, v_idx, v_lat, v_alive, v_first,
                      e_idx, e_lat, e_alive, e_first) -> None:
@@ -331,7 +340,7 @@ class DeviceSweep:
             raise ValueError("windows must be a non-empty list")
         if windows is None:
             windows = [window if window is not None else -1]
-        wlist = [(-1 if w is None else int(w)) for w in windows]
+        wlist = normalize_windows(windows)
 
         runner = _compiled_run(program, self.n_pad, self.m_pad, len(wlist),
                                np.dtype(self.tdtype).name)

@@ -1,0 +1,148 @@
+"""Hop-batched columnar PageRank — the whole range sweep in one dispatch.
+
+The per-hop engines (``bsp``, ``device_sweep``) pay the device's
+per-element random-access rate once per (hop, window, iteration): scalar
+ranks move 4 bytes per edge endpoint. This runner instead evaluates EVERY
+(hop, window) view of a range sweep simultaneously as COLUMNS of one
+program: the per-edge access becomes a C-wide row move (row-tile gathers
+and row segment-sums run at bandwidth, not at the per-element rate —
+measured, tools/tpu_physics.py), the per-iteration dispatch overhead is
+paid once for the whole sweep, and the temporal dimension is captured
+up-front as per-hop fold-state COLUMNS (``lat[:, j]`` / ``alive[:, j]`` at
+hop j) built incrementally by the host fold — deletes and revivals
+included, not an add-only approximation.
+
+This is the windowed-PageRank-specific engine behind the headline
+benchmark; semantics match ``algorithms/pagerank.py`` exactly
+(power iteration with dangling redistribution and tol-based halting) and
+are tested column-against-``bsp.run`` per (hop, window).
+
+Reference contrast: one compiled program per RANGE QUERY, where the
+reference runs its full actor handshake once per hop
+(``RangeAnalysisTask.scala:18-35``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.events import EventLog
+from ..core.sweep import SweepBuilder
+from .device_sweep import GlobalTables, normalize_windows
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
+              tol: float, max_steps: int, tdt: str):
+    tdt = jnp.dtype(tdt)
+
+    def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
+            hop_of_col, T_col, w_col):
+        info = jnp.iinfo(tdt)
+        lo = jnp.clip(T_col - w_col, info.min, info.max).astype(tdt)  # [C]
+        nowin = w_col < 0
+        # per-column masks from the per-hop fold columns
+        me = e_alive[:, hop_of_col] & (nowin[None, :]
+                                       | (e_lat[:, hop_of_col] >= lo[None, :]))
+        mv = v_alive[:, hop_of_col] & (nowin[None, :]
+                                       | (v_lat[:, hop_of_col] >= lo[None, :]))
+        mef = me.astype(jnp.float32)                    # [m_pad, C]
+        # out-degree per column: combine at src (unsorted scatter, once)
+        out_deg = jax.ops.segment_sum(mef, e_src, num_segments=n_pad)
+        n_act = jnp.maximum(jnp.sum(mv.astype(jnp.float32), axis=0), 1.0)
+        r0 = jnp.where(mv, 1.0 / n_act[None, :], 0.0).astype(jnp.float32)
+        inv_deg = 1.0 / jnp.maximum(out_deg, 1.0)
+        dangling_mask = mv & (out_deg == 0)
+
+        def body(carry):
+            step, r, halted = carry
+            payload = (r * inv_deg)[e_src, :] * mef     # row gather [m, C]
+            agg = jax.ops.segment_sum(
+                payload, e_dst, num_segments=n_pad, indices_are_sorted=True)
+            dangling = jnp.sum(jnp.where(dangling_mask, r, 0.0), axis=0)
+            new = ((1.0 - damping) / n_act[None, :]
+                   + damping * (agg + dangling[None, :] / n_act[None, :]))
+            new = jnp.where(mv, new, 0.0).astype(jnp.float32)
+            col_done = jnp.all((jnp.abs(new - r) < tol) | ~mv, axis=0)
+            # freeze converged columns
+            new = jnp.where(halted[None, :], r, new)
+            return step + 1, new, halted | col_done
+
+        def cond(carry):
+            step, _, halted = carry
+            return (step < max_steps) & ~jnp.all(halted)
+
+        steps, r, _ = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), r0, jnp.zeros((C,), bool)))
+        return r.T, steps   # [C, n_pad], hop-major columns
+
+    return jax.jit(run)
+
+
+class HopBatchedPageRank:
+    """Windowed PageRank over a full hop sweep in one device call.
+
+    ``run(hop_times, windows)`` returns ``(ranks, steps)`` with ranks
+    ``[H*W, n_pad]`` ordered hop-major (hop 0's windows first), rows in the
+    global dense vertex space (``self.tables.uv``).
+    """
+
+    def __init__(self, log: EventLog, damping: float = 0.85,
+                 tol: float = 1e-7, max_steps: int = 20):
+        self.sw = SweepBuilder(log)
+        self.tables = GlobalTables(self.sw)
+        self.damping, self.tol, self.max_steps = damping, tol, max_steps
+        # static edge tables upload once, like DeviceSweep
+        self._e_src = jnp.asarray(self.tables.e_src)
+        self._e_dst = jnp.asarray(self.tables.e_dst)
+
+    def run(self, hop_times, windows):
+        t = self.tables
+        hop_times = [int(x) for x in hop_times]
+        if sorted(hop_times) != hop_times:
+            raise ValueError("hop_times must ascend")
+        if self.sw.t_prev is not None and hop_times[0] < self.sw.t_prev:
+            # the incremental fold only moves forward; a backward batch on
+            # the advanced clock would silently fold nothing (DeviceSweep
+            # raises for the same reason)
+            raise ValueError(
+                f"hop_times must continue forward from the previous batch "
+                f"(got {hop_times[0]} < {self.sw.t_prev}); build a fresh "
+                f"HopBatchedPageRank to go back in history")
+        H = len(hop_times)
+        wlist = normalize_windows(windows)
+        C = H * len(wlist)
+
+        # host fold -> per-hop state columns (deltas would also do; full
+        # column copies are O(m) numpy writes per hop, far below the fold)
+        tdt = t.tdtype
+        e_lat = np.full((t.m_pad, H), t.tmin, tdt)
+        e_alive = np.zeros((t.m_pad, H), bool)
+        v_lat = np.full((t.n_pad, H), t.tmin, tdt)
+        v_alive = np.zeros((t.n_pad, H), bool)
+
+        for j, T in enumerate(hop_times):
+            self.sw._advance(T)
+            pos = t.eng_pos(self.sw.e_enc)
+            e_lat[pos, j] = t.cast_times(self.sw.e_lat)
+            e_alive[pos, j] = self.sw.e_alive
+            nv = len(self.sw.uv)
+            v_lat[:nv, j] = t.cast_times(self.sw.v_lat)
+            v_alive[:nv, j] = self.sw.v_alive
+
+        hop_of_col = np.repeat(np.arange(H, dtype=np.int32), len(wlist))
+        T_col = np.asarray(hop_times, np.int64)[hop_of_col]
+        w_col = np.asarray(wlist * H, np.int64)   # hop-major column order
+        runner = _compiled(t.n_pad, t.m_pad, H, C, float(self.damping),
+                           float(self.tol), int(self.max_steps),
+                           np.dtype(tdt).name)
+        return runner(
+            self._e_src, self._e_dst,
+            jnp.asarray(e_lat), jnp.asarray(e_alive),
+            jnp.asarray(v_lat), jnp.asarray(v_alive),
+            jnp.asarray(hop_of_col),
+            jnp.asarray(T_col), jnp.asarray(w_col))

@@ -71,13 +71,15 @@ for T in (50, 75, 100):
     view_t = build_view(log, T)
     with jax.default_device(jax.local_devices()[0]):
         want_t, _ = bsp.run(pr, view_t, windows=[100, 20])
-    # compare per-vid (sweep rows are the global dense space)
+    # compare per-vid over BOTH window columns (sweep rows are global dense)
     for i, vid in enumerate(view_t.vids):
         if not view_t.v_mask[i]:
             continue
         p = int(np.searchsorted(sweep.t.uv, vid))
-        assert abs(float(np.asarray(want_t)[0, i])
-                   - float(np.asarray(got_s)[0, p])) < 1e-5, (T, int(vid))
+        for wi in (0, 1):
+            assert abs(float(np.asarray(want_t)[wi, i])
+                       - float(np.asarray(got_s)[wi, p])) < 1e-5, \
+                (T, wi, int(vid))
 
 print(f"proc {pid} ok steps={int(steps)}", flush=True)
 '''
