@@ -460,46 +460,65 @@ def bench_scale_pagerank():
     gen_s = _time.perf_counter() - g0
 
     windows = [2_600_000, 86_400]     # month / day
-    pr = PageRank(max_steps=10, tol=1e-7)
+    iters = 10
     T0 = int(0.8 * t_span)
-
-    s0 = _time.perf_counter()
-    ds = DeviceSweep(log)             # host fold + resident upload
-    r, _ = ds.run(pr, T0, windows=windows)      # + compile
-    jax.block_until_ready(r)
-    setup_s = _time.perf_counter() - s0
-
     hops = [T0 + 3_600, T0 + 7_200, T0 + 10_800]   # 1-hour hops
-    t0 = _time.perf_counter()
-    results = [ds.run(pr, int(T), windows=windows)[0] for T in hops]
-    jax.block_until_ready(results)
-    elapsed = _time.perf_counter() - t0
     n_views = len(hops) * len(windows)
-    vps = n_views / elapsed
 
-    # gather/scatter traffic per superstep: rank gather + combine, i32/f32
-    iters = pr.max_steps
-    bytes_moved = n_views * iters * ds.m_pad * (4 + 4 + 4 + 4)
+    try:
+        # hop-batched columnar engine: the whole sweep is one dispatch and
+        # per-edge traffic is C-wide rows (engine/hopbatch.py)
+        from raphtory_tpu.engine.hopbatch import HopBatchedPageRank
+
+        s0 = _time.perf_counter()
+        hb = HopBatchedPageRank(log, tol=1e-7, max_steps=iters)
+        jax.block_until_ready(hb.run([T0], windows)[0])  # fold+upload+compile
+        setup_s = _time.perf_counter() - s0
+
+        t0 = _time.perf_counter()
+        ranks, _ = hb.run(hops, windows)
+        jax.block_until_ready(ranks)
+        elapsed = _time.perf_counter() - t0
+        m_pad = hb.tables.m_pad
+        uniq = hb.tables.m
+        engine = "hop_batched_columnar"
+        # per iteration: C-wide payload rows read+write + index columns
+        bytes_moved = iters * m_pad * (2 * n_views * 4 + 8)
+    except Exception as e:
+        from raphtory_tpu.algorithms import PageRank
+
+        pr = PageRank(max_steps=iters, tol=1e-7)
+        s0 = _time.perf_counter()
+        ds = DeviceSweep(log)             # host fold + resident upload
+        jax.block_until_ready(ds.run(pr, T0, windows=windows)[0])
+        setup_s = _time.perf_counter() - s0
+        t0 = _time.perf_counter()
+        results = [ds.run(pr, int(T), windows=windows)[0] for T in hops]
+        jax.block_until_ready(results)
+        elapsed = _time.perf_counter() - t0
+        m_pad, uniq = ds.m_pad, ds.m
+        engine = f"device_sweep (hopbatch failed: {type(e).__name__})"
+        bytes_moved = n_views * iters * m_pad * (4 + 4 + 4 + 4)
+    vps = n_views / elapsed
     return {
         "metric": ("scale windowed PageRank views/sec "
-                   f"({n_v / 1e6:.1f}M v / {n_e / 1e6:.0f}M edge events, "
+                   f"({n_v / 1e6:.1f}M v / {n_e / 1e6:.1f}M edge events, "
                    "10 iters, 1-hour hops)"),
         "value": round(vps, 4),
         "unit": "views/sec",
         "vs_baseline": round(vps * REF_VIEW_S, 2),
         "detail": {
             "n_views": n_views,
+            "engine": engine,
             "sweep_seconds": round(elapsed, 2),
             "seconds_per_view": round(elapsed / n_views, 2),
             "setup_seconds": round(setup_s, 2),
             "synth_seconds": round(gen_s, 2),
-            "unique_pairs": int(ds.m),
+            "unique_pairs": int(uniq),
             "achieved_GBps": round(bytes_moved / elapsed / 1e9, 2),
             "hbm_peak_GBps": PEAK_HBM_GBPS,
             "bandwidth_util_pct": round(
                 100 * bytes_moved / elapsed / 1e9 / PEAK_HBM_GBPS, 2),
-            "note": ("per-edge random access bound; see scale_features for "
-                     "the bandwidth-tiled workload class"),
             "baseline": "reference cannot load this scale in-memory "
                         "(paper §6.1 tops out well below 100M updates/node)",
         },
@@ -551,7 +570,7 @@ def bench_scale_features():
     flops = len(calls) * fa.flops(rounds)
     return {
         "metric": (f"scale windowed {F}-d feature aggregation views/sec "
-                   f"({n_v / 1e6:.1f}M v / {n_e / 1e6:.0f}M edges, "
+                   f"({n_v / 1e6:.1f}M v / {n_e / 1e6:.1f}M edges, "
                    f"{rounds} rounds)"),
         "value": round(vps, 3),
         "unit": "views/sec",
