@@ -1,0 +1,161 @@
+"""Bulk static loading — add-only edge streams at 100M-event scale.
+
+The general ingest path (EventLog → SweepBuilder fold) supports deletes,
+revivals, properties and out-of-order arrival; its comparison sorts cost
+minutes at 10^8 events on one host core. Bulk imports of APPEND-ONLY edge
+streams (the Twitter-2010 / warehouse-export shape) need none of that
+generality, and collapse to radix passes:
+
+* one stable radix argsort of the packed (src, dst) keys builds the global
+  pair table (stability keeps each pair's events time-ascending);
+* per-hop fold state comes from DELTA SLICES of the time-sorted stream —
+  hop j re-sorts only the events in (T_{j-1}, T_j], so a sweep's fold cost
+  is one radix of the first slice plus near-nothing per later hop (the
+  same incremental idea as ``core/sweep.SweepBuilder``, specialised until
+  it is just sorts);
+* "latest event <= T" per pair/vertex is the last row of each run.
+
+The native radix kernel (``rtpu_radix_argsort_u64``) carries the hot
+sorts here; the native batched searchsorted serves the general engines'
+pair lookups (``GlobalTables.eng_pos``). Numpy fallbacks keep every path
+correct without the library.
+
+Output plugs straight into the hop-batched columnar engine
+(``engine/hopbatch.run_columns``): the scale benchmark's whole load+fold
+is seconds of radix passes instead of the general fold's minutes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..engine.device_sweep import _pad_large
+from ..native import lib as _native
+
+
+class BulkGraph:
+    """GlobalTables-shaped static tables over a bulk-loaded pair set."""
+
+    def __init__(self, n_vertices: int, uniq_packed: np.ndarray,
+                 tdtype) -> None:
+        self.n = int(n_vertices)
+        self.m = len(uniq_packed)
+        self.n_pad = _pad_large(self.n)
+        self.m_pad = _pad_large(self.m)
+        self.tdtype = tdtype
+        self.tmin = np.iinfo(tdtype).min
+        self.uv = np.arange(self.n, dtype=np.int64)
+
+        src_r = (uniq_packed >> np.uint64(32)).astype(np.int64)
+        dst_r = (uniq_packed & np.uint64(0xFFFFFFFF)).astype(np.int64)
+        flip = (dst_r.astype(np.uint64) << np.uint64(32)) \
+            | src_r.astype(np.uint64)
+        order = _native.radix_argsort_u64(flip)       # engine (dst, src) sort
+        self.eng_of_rank = np.empty(self.m, np.int64)
+        self.eng_of_rank[order] = np.arange(self.m)
+        self.e_src = np.full(self.m_pad, self.n_pad - 1, np.int32)
+        self.e_dst = np.full(self.m_pad, self.n_pad - 1, np.int32)
+        self.e_src[: self.m] = src_r[order]
+        self.e_dst[: self.m] = dst_r[order]
+
+
+def _run_last(sorted_keys: np.ndarray):
+    """Indices of the LAST row of each equal-key run (keys sorted)."""
+    if len(sorted_keys) == 0:
+        return np.empty(0, np.int64)
+    change = np.flatnonzero(sorted_keys[1:] != sorted_keys[:-1])
+    return np.concatenate([change, [len(sorted_keys) - 1]])
+
+
+def bulk_hop_columns(src, dst, times, hop_times, n_vertices: int | None = None):
+    """Load an ADD-ONLY edge stream and fold it at each hop time.
+
+    ``src``/``dst``: dense non-negative int vertex ids (< 2^31);
+    ``times``: non-decreasing event times (sort the stream first if not);
+    ``hop_times``: ascending fold timestamps.
+
+    Returns ``(bulk, e_lat, e_alive, v_lat, v_alive)`` with the column
+    arrays shaped ``[m_pad, H]`` / ``[n_pad, H]`` in the bulk graph's
+    engine order — exactly what ``engine.hopbatch.run_columns`` consumes.
+    """
+    src = np.ascontiguousarray(src, np.int64)
+    dst = np.ascontiguousarray(dst, np.int64)
+    times = np.ascontiguousarray(times, np.int64)
+    hop_times = [int(x) for x in hop_times]
+    if sorted(hop_times) != hop_times:
+        raise ValueError("hop_times must ascend")
+    if len(times):
+        # one comparison pass (no int64 diff temp at 100M scale); endpoints
+        # then bound the whole sorted array in O(1)
+        if not np.all(times[:-1] <= times[1:]):
+            raise ValueError("bulk loader needs a time-sorted stream — "
+                             "argsort by time first (radix_argsort_u64)")
+        if times[0] < 0 or times[-1] >= 2**31:
+            raise ValueError("bulk loader needs times in [0, 2^31) — use "
+                             "the general EventLog path for wider clocks")
+    id_max = max(int(src.max()), int(dst.max())) if len(src) else -1
+    n_v = int(n_vertices) if n_vertices is not None else id_max + 1
+    if len(src) and (src.min() < 0 or dst.min() < 0 or id_max >= 2**31):
+        raise ValueError("bulk loader needs dense ids in [0, 2^31)")
+    if id_max >= n_v:
+        # an out-of-range id would silently mark PADDING vertices alive and
+        # skew every column's rank mass — refuse instead
+        raise ValueError(
+            f"vertex id {id_max} >= n_vertices ({n_v})")
+
+    tdtype = np.int32
+    packed = (src.astype(np.uint64) << np.uint64(32)) | dst.astype(np.uint64)
+    order_all = _native.radix_argsort_u64(packed)
+    sp = packed[order_all]
+    uniq = sp[_run_last(sp)]          # last-of-run == unique, sorted
+    bulk = BulkGraph(n_v, uniq, tdtype)
+    # pair rank per EVENT, recovered from the one full-stream sort — the
+    # per-slice folds below then never binary-search the pair table
+    starts = np.ones(len(sp), bool)
+    starts[1:] = sp[1:] != sp[:-1]
+    rank_sorted = np.cumsum(starts) - 1
+    rank_of_event = np.empty(len(sp), np.int64)
+    rank_of_event[order_all] = rank_sorted
+
+    H = len(hop_times)
+    e_lat = np.full((bulk.m_pad, H), bulk.tmin, tdtype)
+    e_alive = np.zeros((bulk.m_pad, H), bool)
+    v_lat = np.full((bulk.n_pad, H), bulk.tmin, tdtype)
+    v_alive = np.zeros((bulk.n_pad, H), bool)
+
+    lat_e = np.full(bulk.m_pad, bulk.tmin, tdtype)   # running engine-order
+    al_e = np.zeros(bulk.m_pad, bool)
+    lat_v = np.full(bulk.n_pad, bulk.tmin, tdtype)
+    al_v = np.zeros(bulk.n_pad, bool)
+
+    prev = 0
+    for j, T in enumerate(hop_times):
+        hi = int(np.searchsorted(times, T, side="right"))
+        if hi > prev:
+            ps = rank_of_event[prev:hi].astype(np.uint64)
+            ts = times[prev:hi]
+            o = _native.radix_argsort_u64(ps)        # stable: time-asc in run
+            pss, tss = ps[o], ts[o]
+            last = _run_last(pss)
+            pos = bulk.eng_of_rank[pss[last].astype(np.int64)]
+            lat_e[pos] = tss[last].astype(tdtype)
+            al_e[pos] = True
+            # vertex fold: interleave endpoints so the concatenated stream
+            # stays time-ascending (both endpoints of an event adjacent)
+            vk = np.empty(2 * (hi - prev), np.uint64)
+            vk[0::2] = src[prev:hi].astype(np.uint64)
+            vk[1::2] = dst[prev:hi].astype(np.uint64)
+            vt = np.repeat(ts, 2)
+            ov = _native.radix_argsort_u64(vk)
+            vks, vts = vk[ov], vt[ov]
+            lastv = _run_last(vks)
+            vid = vks[lastv].astype(np.int64)
+            lat_v[vid] = vts[lastv].astype(tdtype)
+            al_v[vid] = True
+            prev = hi
+        e_lat[:, j] = lat_e
+        e_alive[:, j] = al_e
+        v_lat[:, j] = lat_v
+        v_alive[:, j] = al_v
+
+    return bulk, e_lat, e_alive, v_lat, v_alive

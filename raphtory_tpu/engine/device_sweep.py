@@ -44,6 +44,7 @@ import numpy as np
 from ..core.events import EDGE_ADD, EDGE_DELETE, EventLog
 from ..core.snapshot import INT64_MIN, _pad_bucket
 from ..core.sweep import _ENC_MASK, _ENC_SHIFT, SweepBuilder
+from ..native import lib as _native
 from .bsp import make_mask_runner
 from .program import VertexProgram
 
@@ -116,7 +117,15 @@ class GlobalTables:
         self.vids[: self.n] = self.uv
 
     def eng_pos(self, enc: np.ndarray) -> np.ndarray:
-        """Engine positions of packed pair keys (must exist in the log)."""
+        """Engine positions of packed pair keys (must exist in the log).
+        Packed keys are non-negative (dense<<32|dense), so the sorted i64
+        table reinterprets as u64 zero-copy for the native parallel
+        searchsorted — the hot per-hop lookup at 10^8-pair scale."""
+        if len(enc) > (1 << 16) and _native.available():
+            idx = _native.searchsorted_u64(
+                self.all_enc.view(np.uint64),
+                np.ascontiguousarray(enc).view(np.uint64))
+            return self.eng_of_rank[idx]
         return self.eng_of_rank[np.searchsorted(self.all_enc, enc)]
 
     def cast_times(self, a: np.ndarray) -> np.ndarray:

@@ -134,15 +134,32 @@ class HopBatchedPageRank:
             v_lat[:nv, j] = t.cast_times(self.sw.v_lat)
             v_alive[:nv, j] = self.sw.v_alive
 
-        hop_of_col = np.repeat(np.arange(H, dtype=np.int32), len(wlist))
-        T_col = np.asarray(hop_times, np.int64)[hop_of_col]
-        w_col = np.asarray(wlist * H, np.int64)   # hop-major column order
-        runner = _compiled(t.n_pad, t.m_pad, H, C, float(self.damping),
-                           float(self.tol), int(self.max_steps),
-                           np.dtype(tdt).name)
-        return runner(
-            self._e_src, self._e_dst,
-            jnp.asarray(e_lat), jnp.asarray(e_alive),
-            jnp.asarray(v_lat), jnp.asarray(v_alive),
-            jnp.asarray(hop_of_col),
-            jnp.asarray(T_col), jnp.asarray(w_col))
+        return run_columns(
+            t, e_lat, e_alive, v_lat, v_alive, hop_times, windows,
+            damping=self.damping, tol=self.tol, max_steps=self.max_steps,
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst)
+
+
+def run_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times, windows,
+                *, damping: float = 0.85, tol: float = 1e-7,
+                max_steps: int = 20, e_src_dev=None, e_dst_dev=None):
+    """Dispatch the columnar PageRank over prebuilt per-hop fold columns —
+    shared by the incremental-fold class above and the add-only bulk loader
+    (``core/bulk.bulk_hop_columns``). `tables` needs the GlobalTables /
+    BulkGraph surface (n_pad, m_pad, e_src, e_dst, tdtype)."""
+    H = len(hop_times)
+    wlist = normalize_windows(windows)
+    C = H * len(wlist)
+    hop_of_col = np.repeat(np.arange(H, dtype=np.int32), len(wlist))
+    T_col = np.asarray([int(x) for x in hop_times], np.int64)[hop_of_col]
+    w_col = np.asarray(wlist * H, np.int64)       # hop-major column order
+    runner = _compiled(tables.n_pad, tables.m_pad, H, C, float(damping),
+                       float(tol), int(max_steps),
+                       np.dtype(tables.tdtype).name)
+    return runner(
+        e_src_dev if e_src_dev is not None else jnp.asarray(tables.e_src),
+        e_dst_dev if e_dst_dev is not None else jnp.asarray(tables.e_dst),
+        jnp.asarray(e_lat), jnp.asarray(e_alive),
+        jnp.asarray(v_lat), jnp.asarray(v_alive),
+        jnp.asarray(hop_of_col),
+        jnp.asarray(T_col), jnp.asarray(w_col))

@@ -39,7 +39,7 @@ def lib_path() -> Path | None:
     # or concurrent build can never leave a half-written library at `out`
     tmp = out.with_name(f".{out.name}.{os.getpid()}.tmp")
     cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
         "-fno-math-errno", "-o", str(tmp), str(_SRC),
     ]
     try:

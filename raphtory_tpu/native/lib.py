@@ -58,6 +58,12 @@ def _load():
     lib.rtpu_parse_int_csv.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, _i64p,
         ctypes.c_int64, _i64p, ctypes.c_int64]
+    _u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.rtpu_radix_argsort_u64.restype = None
+    lib.rtpu_radix_argsort_u64.argtypes = [ctypes.c_int64, _u64p, _i64p]
+    lib.rtpu_searchsorted_u64.restype = None
+    lib.rtpu_searchsorted_u64.argtypes = [
+        ctypes.c_int64, _u64p, ctypes.c_int64, _u64p, ctypes.c_int32, _i64p]
     _lib = lib
     return _lib
 
@@ -141,6 +147,37 @@ def lex_lookup2(b1, b2, q1, q2) -> np.ndarray | None:
     out = np.empty(len(q1), np.int64)
     lib.rtpu_lex_lookup2(
         len(b1), _p64(b1), _p64(b2), len(q1), _p64(q1), _p64(q2), _p64(out))
+    return out
+
+
+def radix_argsort_u64(keys: np.ndarray) -> np.ndarray:
+    """STABLE argsort of uint64 keys — parallel native radix when available
+    (seconds at 100M keys), numpy stable sort otherwise."""
+    lib = _load()
+    keys = np.ascontiguousarray(keys, np.uint64)
+    if lib is None:
+        return np.argsort(keys, kind="stable")
+    order = np.empty(len(keys), np.int64)
+    lib.rtpu_radix_argsort_u64(
+        len(keys), keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        _p64(order))
+    return order
+
+
+def searchsorted_u64(base: np.ndarray, queries: np.ndarray,
+                     side: str = "left") -> np.ndarray:
+    """Parallel batched searchsorted over a sorted uint64 array."""
+    lib = _load()
+    base = np.ascontiguousarray(base, np.uint64)
+    queries = np.ascontiguousarray(queries, np.uint64)
+    if lib is None:
+        return np.searchsorted(base, queries, side=side)
+    out = np.empty(len(queries), np.int64)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.rtpu_searchsorted_u64(
+        len(base), base.ctypes.data_as(u64p),
+        len(queries), queries.ctypes.data_as(u64p),
+        1 if side == "right" else 0, _p64(out))
     return out
 
 

@@ -102,15 +102,15 @@ def ldbc_like_log(
     return log
 
 
-def gab_like_log(
+def gab_like_arrays(
     n_vertices: int = 30_000,
     n_edges: int = 300_000,
     seed: int = 7,
-    t_span: int = 2_600_000,  # ~a month of seconds
-) -> EventLog:
-    """GAB-style social graph: preferential attachment (heavy-tailed in-degree,
-    one giant component ~ the README demo's 22k-vertex biggest cluster),
-    timestamps spread over the span so windowed views are non-trivial."""
+    t_span: int = 2_600_000,
+):
+    """(src, dst, times) arrays of the GAB-style preferential-attachment
+    stream — the raw form the bulk loader (core/bulk.py) ingests without an
+    EventLog round-trip."""
     rng = np.random.default_rng(seed)
     # preferential attachment via repeated-endpoint sampling trick: draw dst
     # from previously used endpoints with prob p, else uniform
@@ -123,6 +123,19 @@ def gab_like_log(
     dst[~reuse] = pool[~reuse]
     dst[reuse] = src[earlier[reuse]]
     times = np.sort(rng.integers(0, t_span, n_edges)).astype(np.int64)
+    return src, dst, times
+
+
+def gab_like_log(
+    n_vertices: int = 30_000,
+    n_edges: int = 300_000,
+    seed: int = 7,
+    t_span: int = 2_600_000,  # ~a month of seconds
+) -> EventLog:
+    """GAB-style social graph: preferential attachment (heavy-tailed in-degree,
+    one giant component ~ the README demo's 22k-vertex biggest cluster),
+    timestamps spread over the span so windowed views are non-trivial."""
+    src, dst, times = gab_like_arrays(n_vertices, n_edges, seed, t_span)
     kinds = np.full(n_edges, EDGE_ADD, np.uint8)
     log = EventLog()
     log.append_batch(times, kinds, src, dst)

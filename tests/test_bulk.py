@@ -1,0 +1,109 @@
+"""Bulk add-only loader vs the general EventLog path, fold-for-fold."""
+
+import numpy as np
+import pytest
+
+from raphtory_tpu.algorithms import PageRank
+from raphtory_tpu.core.bulk import bulk_hop_columns
+from raphtory_tpu.core.events import EventLog
+from raphtory_tpu.core.snapshot import build_view
+from raphtory_tpu.engine import bsp
+from raphtory_tpu.engine.hopbatch import run_columns
+from raphtory_tpu.native import lib as native
+
+
+def _stream(seed, n_events=2000, n_ids=50, t_span=300):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n_ids, n_events).astype(np.int64)
+    dst = rng.integers(0, n_ids, n_events).astype(np.int64)
+    times = np.sort(rng.integers(0, t_span, n_events)).astype(np.int64)
+    return src, dst, times
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_bulk_columns_match_eventlog_fold(seed):
+    src, dst, times = _stream(seed)
+    hops = [60, 150, 151, 299]
+    bulk, e_lat, e_alive, v_lat, v_alive = bulk_hop_columns(
+        src, dst, times, hops)
+
+    log = EventLog()
+    log.append_batch(times, np.full(len(src), 2, np.uint8), src, dst)
+    for j, T in enumerate(hops):
+        view = build_view(log, T)
+        # vertex fold: alive set + latest times
+        for i, vid in enumerate(view.vids[: view.n_active]):
+            assert v_alive[int(vid), j], (T, int(vid))
+            assert v_lat[int(vid), j] == view.v_latest_time[i], (T, int(vid))
+        assert int(v_alive[:, j].sum()) == view.n_active
+        # edge fold: alive pairs + latest times, via the engine order
+        got_pairs = {}
+        for p in range(bulk.m):
+            if e_alive[p, j]:
+                got_pairs[(int(bulk.e_src[p]), int(bulk.e_dst[p]))] = \
+                    int(e_lat[p, j])
+        want_pairs = {}
+        for p in range(view.m_active):
+            want_pairs[(int(view.vids[view.e_src[p]]),
+                        int(view.vids[view.e_dst[p]]))] = \
+                int(view.e_latest_time[p])
+        assert got_pairs == want_pairs, T
+
+
+def test_bulk_run_columns_matches_per_view_pagerank():
+    src, dst, times = _stream(3, n_events=1500, n_ids=40)
+    hops = [100, 299]
+    windows = [400, 50]
+    bulk, *cols = bulk_hop_columns(src, dst, times, hops)
+    ranks, _ = run_columns(bulk, *cols, hops, windows,
+                           tol=1e-7, max_steps=20)
+    ranks = np.asarray(ranks)
+
+    log = EventLog()
+    log.append_batch(times, np.full(len(src), 2, np.uint8), src, dst)
+    pr = PageRank(max_steps=20, tol=1e-7)
+    for j, T in enumerate(hops):
+        view = build_view(log, T)
+        want, _ = bsp.run(pr, view, windows=windows)
+        for i, w in enumerate(windows):
+            col = ranks[j * len(windows) + i]
+            mask = view.window_masks([w])[0][0]
+            for vi, vid in enumerate(view.vids):
+                if mask[vi]:
+                    assert float(np.asarray(want)[i, vi]) == pytest.approx(
+                        float(col[int(vid)]), abs=2e-5), (T, w, int(vid))
+
+
+def test_bulk_loader_input_validation():
+    src, dst, times = _stream(1, n_events=100)
+    with pytest.raises(ValueError, match="ascend"):
+        bulk_hop_columns(src, dst, times, [50, 10])
+    with pytest.raises(ValueError, match="time-sorted"):
+        bulk_hop_columns(src, dst, times[::-1].copy(), [50])
+    with pytest.raises(ValueError, match="dense ids"):
+        bulk_hop_columns(src - 5, dst, times, [50])
+
+
+def test_native_radix_and_searchsorted_match_numpy():
+    rng = np.random.default_rng(4)
+    keys = rng.integers(0, 2**63, 50_000, dtype=np.uint64)
+    order = native.radix_argsort_u64(keys)
+    np.testing.assert_array_equal(keys[order], np.sort(keys))
+    # stability on heavy duplicates
+    dup = (rng.integers(0, 7, 20_000).astype(np.uint64) << np.uint64(32))
+    o = native.radix_argsort_u64(dup)
+    for b in range(7):
+        idx = o[dup[o] == (np.uint64(b) << np.uint64(32))]
+        assert np.all(np.diff(idx) > 0)
+    base = np.sort(keys)
+    q = rng.integers(0, 2**63, 10_000, dtype=np.uint64)
+    for side in ("left", "right"):
+        np.testing.assert_array_equal(
+            native.searchsorted_u64(base, q, side),
+            np.searchsorted(base, q, side=side))
+
+
+def test_bulk_rejects_out_of_range_ids():
+    src, dst, times = _stream(2, n_events=100, n_ids=50)
+    with pytest.raises(ValueError, match=">= n_vertices"):
+        bulk_hop_columns(src, dst, times, [50], n_vertices=10)
