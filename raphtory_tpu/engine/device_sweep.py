@@ -269,7 +269,7 @@ class DeviceSweep:
         if nv > self.n_pad // 2 or ne > self.m_pad // 2:
             self._refresh_full()
             return
-        e_pos = self._eng_of_rank[np.searchsorted(self.all_enc, d["e_enc"])]
+        e_pos = self.tables.eng_pos(d["e_enc"])
         n_chunks = max(-(-nv // self.cap_v), -(-ne // self.cap_e), 1)
         for i in range(n_chunks):
             ov, oe = i * self.cap_v, i * self.cap_e
@@ -323,7 +323,7 @@ class DeviceSweep:
         e_lat = np.full(self.m_pad, self._tmin, tdt)
         e_alive = np.zeros(self.m_pad, bool)
         e_first = np.full(self.m_pad, self._tmin, tdt)
-        pos = self._eng_of_rank[np.searchsorted(self.all_enc, sw.e_enc)]
+        pos = self.tables.eng_pos(sw.e_enc)
         e_lat[pos] = self._cast_t(sw.e_lat)
         e_alive[pos] = sw.e_alive
         e_first[pos] = self._cast_t(sw.e_first)

@@ -114,8 +114,6 @@ class HopBatchedPageRank:
                 f"(got {hop_times[0]} < {self.sw.t_prev}); build a fresh "
                 f"HopBatchedPageRank to go back in history")
         H = len(hop_times)
-        wlist = normalize_windows(windows)
-        C = H * len(wlist)
 
         # host fold -> per-hop state columns (deltas would also do; full
         # column copies are O(m) numpy writes per hop, far below the fold)

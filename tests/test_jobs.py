@@ -239,3 +239,68 @@ def test_single_device_range_uses_device_sweep_and_matches():
         assert row["result"]["vertices"] == expect["vertices"], row["time"]
         assert row["result"]["clusters"] == expect["clusters"], row["time"]
         assert row["result"]["top5"] == expect["top5"], row["time"]
+
+
+def test_module_entrypoint_serves_rest(tmp_path):
+    """python -m raphtory_tpu serve: boots the node, ingests a CSV, serves
+    the REST job API, shuts down on SIGTERM."""
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as _t
+    import urllib.request
+
+    csv = tmp_path / "edges.csv"
+    csv.write_text("".join(f"{i % 9},{(i + 1) % 9},{i}\n" for i in range(300)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    env["RAPHTORY_TPU_REST_PORT"] = "18231"
+    env["RAPHTORY_TPU_METRICS_PORT"] = "18232"
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.Popen(
+        [sys.executable, "-m", "raphtory_tpu", "serve", "--csv", str(csv),
+         "--platform", "cpu"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = _t.monotonic() + 120
+        up = False
+        while _t.monotonic() < deadline:
+            try:
+                req = urllib.request.Request(
+                    "http://127.0.0.1:18231/ViewAnalysisRequest",
+                    data=json.dumps({
+                        "analyserName": "ConnectedComponents",
+                        "jobID": "boot", "timestamp": 299}).encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=5)
+                up = True
+                break
+            except OSError:
+                _t.sleep(0.3)
+        assert up, "server never came up"
+        deadline = _t.monotonic() + 60
+        while _t.monotonic() < deadline:
+            rows = json.loads(urllib.request.urlopen(
+                "http://127.0.0.1:18231/AnalysisResults?jobID=boot",
+                timeout=5).read())
+            if rows["status"] == "done":
+                break
+            _t.sleep(0.2)
+        assert rows["status"] == "done", rows
+        assert rows["results"][0]["result"]["vertices"] == 9
+        # metrics endpoint answers too
+        body = urllib.request.urlopen(
+            "http://127.0.0.1:18232/metrics", timeout=5).read().decode()
+        assert "rtpu_" in body or "updates" in body, body[:200]
+    finally:
+        p.send_signal(signal.SIGTERM)
+        try:
+            out, _ = p.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+    assert p.returncode == 0, out[-2000:]
