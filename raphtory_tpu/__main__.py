@@ -53,14 +53,16 @@ def _serve(args) -> int:
           f"metrics :{settings.metrics_port}", flush=True)
 
     rt.ingest(wait=False)
-    stop = threading.Event()
-    signal.signal(signal.SIGINT, lambda *a: stop.set())
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
     if args.ingest_only:
+        # default signal behaviour stays in place: Ctrl-C / SIGTERM abort
+        # the blocking join instead of being swallowed by a no-op handler
         rt.pipeline.join()
         print(f"ingest done: {sum(rt.pipeline.counts.values())} updates, "
               f"safe_time={rt.graph.safe_time()}", flush=True)
     else:
+        stop = threading.Event()
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
         stop.wait()
     rt.stop()
     return 0

@@ -83,24 +83,76 @@ def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
     return jax.jit(run)
 
 
-class HopBatchedPageRank:
-    """Windowed PageRank over a full hop sweep in one device call.
+@functools.lru_cache(maxsize=64)
+def _compiled_cc(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
+                 tdt: str):
+    """Columnar min-label propagation — connected components for every
+    (hop, window) column at once (semantics of
+    ``algorithms/connected_components.py``: undirected min over both
+    directions, labels are global padded indices)."""
+    tdt = jnp.dtype(tdt)
+    I32_MAX = jnp.iinfo(jnp.int32).max
 
-    ``run(hop_times, windows)`` returns ``(ranks, steps)`` with ranks
-    ``[H*W, n_pad]`` ordered hop-major (hop 0's windows first), rows in the
-    global dense vertex space (``self.tables.uv``).
-    """
+    def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
+            hop_of_col, T_col, w_col):
+        info = jnp.iinfo(tdt)
+        lo = jnp.clip(T_col - w_col, info.min, info.max).astype(tdt)
+        nowin = w_col < 0
+        me = e_alive[:, hop_of_col] & (nowin[None, :]
+                                       | (e_lat[:, hop_of_col] >= lo[None, :]))
+        mv = v_alive[:, hop_of_col] & (nowin[None, :]
+                                       | (v_lat[:, hop_of_col] >= lo[None, :]))
+        lab0 = jnp.where(mv, jnp.arange(n_pad, dtype=jnp.int32)[:, None],
+                         I32_MAX)
 
-    def __init__(self, log: EventLog, damping: float = 0.85,
-                 tol: float = 1e-7, max_steps: int = 20):
+        def body(carry):
+            step, lab, halted = carry
+            def pull(idx_from, idx_to, sorted_):
+                payload = jnp.where(me, lab[idx_from, :], I32_MAX)
+                return jax.ops.segment_min(
+                    payload, idx_to, num_segments=n_pad,
+                    indices_are_sorted=sorted_)
+            agg = jnp.minimum(pull(e_src, e_dst, True),
+                              pull(e_dst, e_src, False))
+            new = jnp.where(mv, jnp.minimum(lab, agg), I32_MAX)
+            col_done = jnp.all(new == lab, axis=0)
+            new = jnp.where(halted[None, :], lab, new)
+            return step + 1, new, halted | col_done
+
+        def cond(carry):
+            step, _, halted = carry
+            return (step < max_steps) & ~jnp.all(halted)
+
+        steps, lab, _ = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), lab0, jnp.zeros((C,), bool)))
+        return lab.T, steps   # [C, n_pad]
+
+    return jax.jit(run)
+
+
+def run_cc_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
+                   windows, *, max_steps: int = 100,
+                   e_src_dev=None, e_dst_dev=None):
+    """Columnar connected components over prebuilt per-hop fold columns."""
+    H, C, hop_of_col, T_col, w_col = _column_layout(hop_times, windows)
+    runner = _compiled_cc(tables.n_pad, tables.m_pad, H, C, int(max_steps),
+                          np.dtype(tables.tdtype).name)
+    return _dispatch_columns(runner, tables,
+                             (e_lat, e_alive, v_lat, v_alive),
+                             hop_of_col, T_col, w_col, e_src_dev, e_dst_dev)
+
+
+class _HopBatched:
+    """Shared incremental fold → per-hop state columns (deletes included)."""
+
+    def __init__(self, log: EventLog):
         self.sw = SweepBuilder(log)
         self.tables = GlobalTables(self.sw)
-        self.damping, self.tol, self.max_steps = damping, tol, max_steps
         # static edge tables upload once, like DeviceSweep
         self._e_src = jnp.asarray(self.tables.e_src)
         self._e_dst = jnp.asarray(self.tables.e_dst)
 
-    def run(self, hop_times, windows):
+    def _fold_columns(self, hop_times):
         t = self.tables
         hop_times = [int(x) for x in hop_times]
         if sorted(hop_times) != hop_times:
@@ -112,7 +164,7 @@ class HopBatchedPageRank:
             raise ValueError(
                 f"hop_times must continue forward from the previous batch "
                 f"(got {hop_times[0]} < {self.sw.t_prev}); build a fresh "
-                f"HopBatchedPageRank to go back in history")
+                f"{type(self).__name__} to go back in history")
         H = len(hop_times)
 
         # host fold -> per-hop state columns (deltas would also do; full
@@ -131,11 +183,65 @@ class HopBatchedPageRank:
             nv = len(self.sw.uv)
             v_lat[:nv, j] = t.cast_times(self.sw.v_lat)
             v_alive[:nv, j] = self.sw.v_alive
+        return hop_times, (e_lat, e_alive, v_lat, v_alive)
 
+
+class HopBatchedPageRank(_HopBatched):
+    """Windowed PageRank over a full hop sweep in one device call.
+
+    ``run(hop_times, windows)`` returns ``(ranks, steps)`` with ranks
+    ``[H*W, n_pad]`` ordered hop-major (hop 0's windows first), rows in the
+    global dense vertex space (``self.tables.uv``).
+    """
+
+    def __init__(self, log: EventLog, damping: float = 0.85,
+                 tol: float = 1e-7, max_steps: int = 20):
+        super().__init__(log)
+        self.damping, self.tol, self.max_steps = damping, tol, max_steps
+
+    def run(self, hop_times, windows):
+        hop_times, cols = self._fold_columns(hop_times)
         return run_columns(
-            t, e_lat, e_alive, v_lat, v_alive, hop_times, windows,
+            self.tables, *cols, hop_times, windows,
             damping=self.damping, tol=self.tol, max_steps=self.max_steps,
             e_src_dev=self._e_src, e_dst_dev=self._e_dst)
+
+
+class HopBatchedCC(_HopBatched):
+    """Windowed connected components over a full hop sweep in one call;
+    labels decode via ``tables.uv[label]`` (min vid of the component)."""
+
+    def __init__(self, log: EventLog, max_steps: int = 100):
+        super().__init__(log)
+        self.max_steps = max_steps
+
+    def run(self, hop_times, windows):
+        hop_times, cols = self._fold_columns(hop_times)
+        return run_cc_columns(
+            self.tables, *cols, hop_times, windows,
+            max_steps=self.max_steps,
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst)
+
+
+def _dispatch_columns(runner, tables, cols, hop_of_col, T_col,
+                      w_col, e_src_dev, e_dst_dev):
+    """Shared device dispatch for the columnar runners."""
+    return runner(
+        e_src_dev if e_src_dev is not None else jnp.asarray(tables.e_src),
+        e_dst_dev if e_dst_dev is not None else jnp.asarray(tables.e_dst),
+        *(jnp.asarray(a) for a in cols),
+        jnp.asarray(hop_of_col), jnp.asarray(T_col), jnp.asarray(w_col))
+
+
+def _column_layout(hop_times, windows):
+    """Hop-major (hop 0's windows first) column layout shared by every
+    columnar runner — the ONE place the ordering is defined."""
+    H = len(hop_times)
+    wlist = normalize_windows(windows)
+    hop_of_col = np.repeat(np.arange(H, dtype=np.int32), len(wlist))
+    T_col = np.asarray([int(x) for x in hop_times], np.int64)[hop_of_col]
+    w_col = np.asarray(wlist * H, np.int64)
+    return H, H * len(wlist), hop_of_col, T_col, w_col
 
 
 def run_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times, windows,
@@ -145,19 +251,10 @@ def run_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times, windows,
     shared by the incremental-fold class above and the add-only bulk loader
     (``core/bulk.bulk_hop_columns``). `tables` needs the GlobalTables /
     BulkGraph surface (n_pad, m_pad, e_src, e_dst, tdtype)."""
-    H = len(hop_times)
-    wlist = normalize_windows(windows)
-    C = H * len(wlist)
-    hop_of_col = np.repeat(np.arange(H, dtype=np.int32), len(wlist))
-    T_col = np.asarray([int(x) for x in hop_times], np.int64)[hop_of_col]
-    w_col = np.asarray(wlist * H, np.int64)       # hop-major column order
+    H, C, hop_of_col, T_col, w_col = _column_layout(hop_times, windows)
     runner = _compiled(tables.n_pad, tables.m_pad, H, C, float(damping),
                        float(tol), int(max_steps),
                        np.dtype(tables.tdtype).name)
-    return runner(
-        e_src_dev if e_src_dev is not None else jnp.asarray(tables.e_src),
-        e_dst_dev if e_dst_dev is not None else jnp.asarray(tables.e_dst),
-        jnp.asarray(e_lat), jnp.asarray(e_alive),
-        jnp.asarray(v_lat), jnp.asarray(v_alive),
-        jnp.asarray(hop_of_col),
-        jnp.asarray(T_col), jnp.asarray(w_col))
+    return _dispatch_columns(runner, tables,
+                             (e_lat, e_alive, v_lat, v_alive),
+                             hop_of_col, T_col, w_col, e_src_dev, e_dst_dev)

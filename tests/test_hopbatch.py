@@ -59,3 +59,33 @@ def test_hopbatch_rejects_unsorted_hops_and_is_reusable():
     # sanity: ranks are a distribution per column over the masked set
     s = np.asarray(r2).sum(axis=1)
     assert np.all((s > 0.99) & (s < 1.01))
+
+
+@pytest.mark.parametrize("seed", [1, 9])
+def test_hopbatch_cc_matches_per_view(seed):
+    from raphtory_tpu.algorithms import ConnectedComponents
+    from raphtory_tpu.engine.hopbatch import HopBatchedCC
+
+    rng = np.random.default_rng(seed)
+    log = random_log(rng, n_events=500, n_ids=35, t_span=70)
+    hops = [25, 69]
+    windows = [100, 20]
+    hb = HopBatchedCC(log, max_steps=60)
+    labels, _ = hb.run(hops, windows)
+    labels = np.asarray(labels)
+
+    cc = ConnectedComponents(max_steps=60)
+    for j, T in enumerate(hops):
+        view = build_view(log, T)
+        want, _ = bsp.run(cc, view, windows=windows)
+        for i, w in enumerate(windows):
+            col = labels[j * len(windows) + i]
+            mask = view.window_masks([w])[0][0]
+            # both label spaces decode to the component's min vid
+            for vi, vid in enumerate(view.vids):
+                if not mask[vi]:
+                    continue
+                rep_view = int(view.vids[int(np.asarray(want)[i, vi])])
+                p = int(np.searchsorted(hb.tables.uv, vid))
+                rep_hb = int(hb.tables.uv[int(col[p])])
+                assert rep_view == rep_hb, (T, w, int(vid))
