@@ -130,6 +130,74 @@ def _compiled_cc(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
     return jax.jit(run)
 
 
+@functools.lru_cache(maxsize=64)
+def _compiled_bfs(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
+                  directed: bool, tdt: str):
+    """Columnar BFS (min-plus hop counting from seed vertices) — semantics
+    of ``algorithms/traversal.SSSP`` with unit weights."""
+    tdt = jnp.dtype(tdt)
+    INF = jnp.float32(jnp.inf)
+
+    def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
+            hop_of_col, T_col, w_col, seed_mask):
+        info = jnp.iinfo(tdt)
+        lo = jnp.clip(T_col - w_col, info.min, info.max).astype(tdt)
+        nowin = w_col < 0
+        me = e_alive[:, hop_of_col] & (nowin[None, :]
+                                       | (e_lat[:, hop_of_col] >= lo[None, :]))
+        mv = v_alive[:, hop_of_col] & (nowin[None, :]
+                                       | (v_lat[:, hop_of_col] >= lo[None, :]))
+        d0 = jnp.where(mv & seed_mask[:, None], 0.0, INF)
+
+        def body(carry):
+            step, dist, halted = carry
+
+            def pull(idx_from, idx_to, sorted_):
+                payload = jnp.where(me, dist[idx_from, :] + 1.0, INF)
+                return jax.ops.segment_min(
+                    payload, idx_to, num_segments=n_pad,
+                    indices_are_sorted=sorted_)
+
+            agg = pull(e_src, e_dst, True)
+            if not directed:
+                agg = jnp.minimum(agg, pull(e_dst, e_src, False))
+            new = jnp.where(mv, jnp.minimum(dist, agg), INF)
+            col_done = jnp.all(new == dist, axis=0)
+            new = jnp.where(halted[None, :], dist, new)
+            return step + 1, new, halted | col_done
+
+        def cond(carry):
+            step, _, halted = carry
+            return (step < max_steps) & ~jnp.all(halted)
+
+        steps, dist, _ = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), d0, jnp.zeros((C,), bool)))
+        return dist.T, steps   # [C, n_pad]
+
+    return jax.jit(run)
+
+
+def run_bfs_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
+                    windows, seed_vids, *, directed: bool = False,
+                    max_steps: int = 100, e_src_dev=None, e_dst_dev=None):
+    """Columnar BFS over prebuilt fold columns; ``seed_vids`` are external
+    vertex ids looked up in the global dense space (absent ids ignored)."""
+    H, C, hop_of_col, T_col, w_col = _column_layout(hop_times, windows)
+    seed_mask = np.zeros(tables.n_pad, bool)
+    seeds = np.asarray(sorted({int(v) for v in seed_vids}), np.int64)
+    if len(seeds) and len(tables.uv):
+        pos = np.clip(np.searchsorted(tables.uv, seeds), 0,
+                      len(tables.uv) - 1)
+        ok = tables.uv[pos] == seeds
+        seed_mask[pos[ok]] = True
+    runner = _compiled_bfs(tables.n_pad, tables.m_pad, H, C, int(max_steps),
+                           bool(directed), np.dtype(tables.tdtype).name)
+    return _dispatch_columns(runner, tables,
+                             (e_lat, e_alive, v_lat, v_alive),
+                             hop_of_col, T_col, w_col, e_src_dev, e_dst_dev,
+                             seed_mask)
+
+
 def run_cc_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
                    windows, *, max_steps: int = 100,
                    e_src_dev=None, e_dst_dev=None):
@@ -207,6 +275,25 @@ class HopBatchedPageRank(_HopBatched):
             e_src_dev=self._e_src, e_dst_dev=self._e_dst)
 
 
+class HopBatchedBFS(_HopBatched):
+    """Windowed BFS hop counting over a full sweep in one call; distances
+    are f32 with inf for unreached (SSSP-with-unit-weights semantics)."""
+
+    def __init__(self, log: EventLog, seeds, directed: bool = False,
+                 max_steps: int = 100):
+        super().__init__(log)
+        self.seeds = tuple(seeds)
+        self.directed = directed
+        self.max_steps = max_steps
+
+    def run(self, hop_times, windows):
+        hop_times, cols = self._fold_columns(hop_times)
+        return run_bfs_columns(
+            self.tables, *cols, hop_times, windows, self.seeds,
+            directed=self.directed, max_steps=self.max_steps,
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst)
+
+
 class HopBatchedCC(_HopBatched):
     """Windowed connected components over a full hop sweep in one call;
     labels decode via ``tables.uv[label]`` (min vid of the component)."""
@@ -224,13 +311,15 @@ class HopBatchedCC(_HopBatched):
 
 
 def _dispatch_columns(runner, tables, cols, hop_of_col, T_col,
-                      w_col, e_src_dev, e_dst_dev):
-    """Shared device dispatch for the columnar runners."""
+                      w_col, e_src_dev, e_dst_dev, *extra):
+    """Shared device dispatch for the columnar runners (`extra` appends
+    runner-specific trailing args, e.g. the BFS seed mask)."""
     return runner(
         e_src_dev if e_src_dev is not None else jnp.asarray(tables.e_src),
         e_dst_dev if e_dst_dev is not None else jnp.asarray(tables.e_dst),
         *(jnp.asarray(a) for a in cols),
-        jnp.asarray(hop_of_col), jnp.asarray(T_col), jnp.asarray(w_col))
+        jnp.asarray(hop_of_col), jnp.asarray(T_col), jnp.asarray(w_col),
+        *(jnp.asarray(a) for a in extra))
 
 
 def _column_layout(hop_times, windows):

@@ -89,3 +89,35 @@ def test_hopbatch_cc_matches_per_view(seed):
                 p = int(np.searchsorted(hb.tables.uv, vid))
                 rep_hb = int(hb.tables.uv[int(col[p])])
                 assert rep_view == rep_hb, (T, w, int(vid))
+
+
+@pytest.mark.parametrize("directed", [False, True])
+def test_hopbatch_bfs_matches_per_view(directed):
+    from raphtory_tpu.algorithms import SSSP
+    from raphtory_tpu.engine.hopbatch import HopBatchedBFS
+
+    rng = np.random.default_rng(6)
+    log = random_log(rng, n_events=400, n_ids=30, t_span=60)
+    hops = [25, 59]
+    windows = [100, 15]
+    seeds = (0, 1, 2)
+    hb = HopBatchedBFS(log, seeds, directed=directed, max_steps=40)
+    dist, _ = hb.run(hops, windows)
+    dist = np.asarray(dist)
+
+    bfs = SSSP(seeds=seeds, weight_prop=None, directed=directed,
+               max_steps=40)
+    for j, T in enumerate(hops):
+        view = build_view(log, T)
+        want, _ = bsp.run(bfs, view, windows=windows)
+        for i, w in enumerate(windows):
+            col = dist[j * len(windows) + i]
+            mask = view.window_masks([w])[0][0]
+            for vi, vid in enumerate(view.vids):
+                if not mask[vi]:
+                    continue
+                p = int(np.searchsorted(hb.tables.uv, vid))
+                a = float(np.asarray(want)[i, vi])
+                b = float(col[p])
+                assert (np.isinf(a) and np.isinf(b)) or a == b, \
+                    (T, w, int(vid), a, b)
