@@ -75,8 +75,9 @@ def bulk_hop_columns(src, dst, times, hop_times, n_vertices: int | None = None):
     ``hop_times``: ascending fold timestamps.
 
     Returns ``(bulk, e_lat, e_alive, v_lat, v_alive)`` with the column
-    arrays shaped ``[m_pad, H]`` / ``[n_pad, H]`` in the bulk graph's
-    engine order — exactly what ``engine.hopbatch.run_columns`` consumes.
+    arrays shaped hop-major ``[H, m_pad]`` / ``[H, n_pad]`` in the bulk
+    graph's engine order — exactly what ``engine.hopbatch.run_columns``
+    consumes (row ``j`` = fold state at ``hop_times[j]``).
     """
     src = np.ascontiguousarray(src, np.int64)
     dst = np.ascontiguousarray(dst, np.int64)
@@ -118,10 +119,10 @@ def bulk_hop_columns(src, dst, times, hop_times, n_vertices: int | None = None):
     rank_of_event[order_all] = rank_sorted
 
     H = len(hop_times)
-    e_lat = np.full((bulk.m_pad, H), bulk.tmin, tdtype)
-    e_alive = np.zeros((bulk.m_pad, H), bool)
-    v_lat = np.full((bulk.n_pad, H), bulk.tmin, tdtype)
-    v_alive = np.zeros((bulk.n_pad, H), bool)
+    e_lat = np.full((H, bulk.m_pad), bulk.tmin, tdtype)
+    e_alive = np.zeros((H, bulk.m_pad), bool)
+    v_lat = np.full((H, bulk.n_pad), bulk.tmin, tdtype)
+    v_alive = np.zeros((H, bulk.n_pad), bool)
 
     lat_e = np.full(bulk.m_pad, bulk.tmin, tdtype)   # running engine-order
     al_e = np.zeros(bulk.m_pad, bool)
@@ -153,9 +154,9 @@ def bulk_hop_columns(src, dst, times, hop_times, n_vertices: int | None = None):
             lat_v[vid] = vts[lastv].astype(tdtype)
             al_v[vid] = True
             prev = hi
-        e_lat[:, j] = lat_e
-        e_alive[:, j] = al_e
-        v_lat[:, j] = lat_v
-        v_alive[:, j] = al_v
+        e_lat[j] = lat_e          # contiguous row memcpy in this layout
+        e_alive[j] = al_e
+        v_lat[j] = lat_v
+        v_alive[j] = al_v
 
     return bulk, e_lat, e_alive, v_lat, v_alive

@@ -8,9 +8,10 @@ program: the per-edge access becomes a C-wide row move (row-tile gathers
 and row segment-sums run at bandwidth, not at the per-element rate —
 measured, tools/tpu_physics.py), the per-iteration dispatch overhead is
 paid once for the whole sweep, and the temporal dimension is captured
-up-front as per-hop fold-state COLUMNS (``lat[:, j]`` / ``alive[:, j]`` at
-hop j) built incrementally by the host fold — deletes and revivals
-included, not an add-only approximation.
+up-front as per-hop fold-state COLUMNS (hop-major ``lat[j]`` /
+``alive[j]`` rows of ``[H, m_pad]``/``[H, n_pad]`` arrays) built
+incrementally by the host fold — deletes and revivals included, not an
+add-only approximation.
 
 This is the windowed-PageRank-specific engine behind the headline
 benchmark; semantics match ``algorithms/pagerank.py`` exactly
@@ -35,6 +36,22 @@ from ..core.sweep import SweepBuilder
 from .device_sweep import GlobalTables, normalize_windows
 
 
+def _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
+                  hop_of_col, T_col, w_col):
+    """Per-column alive masks from the hop-major ``[H, ...]`` fold columns,
+    transposed into the kernels' entity-major ``[..., C]`` layout — the ONE
+    place the windowing test (``latest >= T - w``, ``w < 0`` = unwindowed)
+    is written for all three compiled engines."""
+    info = jnp.iinfo(tdt)
+    lo = jnp.clip(T_col - w_col, info.min, info.max).astype(tdt)   # [C]
+    nowin = w_col < 0
+    me = (e_alive[hop_of_col] & (nowin[:, None]
+                                 | (e_lat[hop_of_col] >= lo[:, None]))).T
+    mv = (v_alive[hop_of_col] & (nowin[:, None]
+                                 | (v_lat[hop_of_col] >= lo[:, None]))).T
+    return me, mv
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
               tol: float, max_steps: int, tdt: str):
@@ -42,14 +59,8 @@ def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
 
     def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
             hop_of_col, T_col, w_col):
-        info = jnp.iinfo(tdt)
-        lo = jnp.clip(T_col - w_col, info.min, info.max).astype(tdt)  # [C]
-        nowin = w_col < 0
-        # per-column masks from the per-hop fold columns
-        me = e_alive[:, hop_of_col] & (nowin[None, :]
-                                       | (e_lat[:, hop_of_col] >= lo[None, :]))
-        mv = v_alive[:, hop_of_col] & (nowin[None, :]
-                                       | (v_lat[:, hop_of_col] >= lo[None, :]))
+        me, mv = _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
+                               hop_of_col, T_col, w_col)
         mef = me.astype(jnp.float32)                    # [m_pad, C]
         # out-degree per column: combine at src (unsorted scatter, once)
         out_deg = jax.ops.segment_sum(mef, e_src, num_segments=n_pad)
@@ -95,13 +106,8 @@ def _compiled_cc(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
 
     def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
             hop_of_col, T_col, w_col):
-        info = jnp.iinfo(tdt)
-        lo = jnp.clip(T_col - w_col, info.min, info.max).astype(tdt)
-        nowin = w_col < 0
-        me = e_alive[:, hop_of_col] & (nowin[None, :]
-                                       | (e_lat[:, hop_of_col] >= lo[None, :]))
-        mv = v_alive[:, hop_of_col] & (nowin[None, :]
-                                       | (v_lat[:, hop_of_col] >= lo[None, :]))
+        me, mv = _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
+                               hop_of_col, T_col, w_col)
         lab0 = jnp.where(mv, jnp.arange(n_pad, dtype=jnp.int32)[:, None],
                          I32_MAX)
 
@@ -140,13 +146,8 @@ def _compiled_bfs(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
 
     def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
             hop_of_col, T_col, w_col, seed_mask):
-        info = jnp.iinfo(tdt)
-        lo = jnp.clip(T_col - w_col, info.min, info.max).astype(tdt)
-        nowin = w_col < 0
-        me = e_alive[:, hop_of_col] & (nowin[None, :]
-                                       | (e_lat[:, hop_of_col] >= lo[None, :]))
-        mv = v_alive[:, hop_of_col] & (nowin[None, :]
-                                       | (v_lat[:, hop_of_col] >= lo[None, :]))
+        me, mv = _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
+                               hop_of_col, T_col, w_col)
         d0 = jnp.where(mv & seed_mask[:, None], 0.0, INF)
 
         def body(carry):
@@ -211,7 +212,14 @@ def run_cc_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
 
 
 class _HopBatched:
-    """Shared incremental fold → per-hop state columns (deletes included)."""
+    """Shared incremental fold → per-hop state columns (deletes included).
+
+    ``run(hop_times, windows, chunks=k)`` splits the sweep into ``k``
+    equal hop groups and dispatches each group as soon as its columns are
+    folded: dispatch is async, so group ``i+1``'s HOST fold overlaps group
+    ``i``'s DEVICE supersteps — the pipelining a one-dispatch sweep can't
+    have. Equal group sizes reuse one compiled program. Results are
+    identical to ``chunks=1`` (hop-major concatenation; tested)."""
 
     def __init__(self, log: EventLog):
         self.sw = SweepBuilder(log)
@@ -219,6 +227,28 @@ class _HopBatched:
         # static edge tables upload once, like DeviceSweep
         self._e_src = jnp.asarray(self.tables.e_src)
         self._e_dst = jnp.asarray(self.tables.e_dst)
+
+    def _dispatch_cols(self, cols, hop_times, windows):
+        raise NotImplementedError
+
+    def run(self, hop_times, windows, chunks: int = 1):
+        hop_times = [int(x) for x in hop_times]
+        chunks = max(1, min(int(chunks), len(hop_times)))
+        if chunks == 1 or len(hop_times) % chunks:
+            # unequal groups would compile one program per distinct size —
+            # pipeline only when the split is clean
+            hop_times, cols = self._fold_columns(hop_times)
+            return self._dispatch_cols(cols, hop_times, windows)
+        per = len(hop_times) // chunks
+        outs = []
+        steps = jnp.int32(0)
+        for c in range(chunks):
+            group = hop_times[c * per: (c + 1) * per]
+            group, cols = self._fold_columns(group)
+            out, st = self._dispatch_cols(cols, group, windows)  # async
+            outs.append(out)
+            steps = jnp.maximum(steps, st)
+        return jnp.concatenate(outs, axis=0), steps
 
     def _fold_columns(self, hop_times):
         t = self.tables
@@ -235,22 +265,40 @@ class _HopBatched:
                 f"{type(self).__name__} to go back in history")
         H = len(hop_times)
 
-        # host fold -> per-hop state columns (deltas would also do; full
-        # column copies are O(m) numpy writes per hop, far below the fold)
+        # host fold -> hop-major state columns [H, m_pad]/[H, n_pad]: hop 0
+        # writes the full fold state, every later hop memcpys the previous
+        # row (contiguous in this layout) and scatters only the hop's exact
+        # touched-entity delta (``sweep.last_delta``) — one O(m) scatter,
+        # then an O(m) contiguous memcpy plus an O(delta) scatter per hop,
+        # instead of an O(m) scattered write per hop
         tdt = t.tdtype
-        e_lat = np.full((t.m_pad, H), t.tmin, tdt)
-        e_alive = np.zeros((t.m_pad, H), bool)
-        v_lat = np.full((t.n_pad, H), t.tmin, tdt)
-        v_alive = np.zeros((t.n_pad, H), bool)
+        e_lat = np.full((H, t.m_pad), t.tmin, tdt)
+        e_alive = np.zeros((H, t.m_pad), bool)
+        v_lat = np.full((H, t.n_pad), t.tmin, tdt)
+        v_alive = np.zeros((H, t.n_pad), bool)
 
         for j, T in enumerate(hop_times):
             self.sw._advance(T)
-            pos = t.eng_pos(self.sw.e_enc)
-            e_lat[pos, j] = t.cast_times(self.sw.e_lat)
-            e_alive[pos, j] = self.sw.e_alive
-            nv = len(self.sw.uv)
-            v_lat[:nv, j] = t.cast_times(self.sw.v_lat)
-            v_alive[:nv, j] = self.sw.v_alive
+            if j == 0:
+                pos = t.eng_pos(self.sw.e_enc)
+                e_lat[0, pos] = t.cast_times(self.sw.e_lat)
+                e_alive[0, pos] = self.sw.e_alive
+                nv = len(self.sw.uv)
+                v_lat[0, :nv] = t.cast_times(self.sw.v_lat)
+                v_alive[0, :nv] = self.sw.v_alive
+                continue
+            e_lat[j] = e_lat[j - 1]
+            e_alive[j] = e_alive[j - 1]
+            v_lat[j] = v_lat[j - 1]
+            v_alive[j] = v_alive[j - 1]
+            d = self.sw.last_delta
+            if len(d["e_enc"]):
+                dpos = t.eng_pos(d["e_enc"])
+                e_lat[j, dpos] = t.cast_times(d["e_lat"])
+                e_alive[j, dpos] = d["e_alive"]
+            if len(d["v_idx"]):
+                v_lat[j, d["v_idx"]] = t.cast_times(d["v_lat"])
+                v_alive[j, d["v_idx"]] = d["v_alive"]
         return hop_times, (e_lat, e_alive, v_lat, v_alive)
 
 
@@ -267,8 +315,7 @@ class HopBatchedPageRank(_HopBatched):
         super().__init__(log)
         self.damping, self.tol, self.max_steps = damping, tol, max_steps
 
-    def run(self, hop_times, windows):
-        hop_times, cols = self._fold_columns(hop_times)
+    def _dispatch_cols(self, cols, hop_times, windows):
         return run_columns(
             self.tables, *cols, hop_times, windows,
             damping=self.damping, tol=self.tol, max_steps=self.max_steps,
@@ -286,8 +333,7 @@ class HopBatchedBFS(_HopBatched):
         self.directed = directed
         self.max_steps = max_steps
 
-    def run(self, hop_times, windows):
-        hop_times, cols = self._fold_columns(hop_times)
+    def _dispatch_cols(self, cols, hop_times, windows):
         return run_bfs_columns(
             self.tables, *cols, hop_times, windows, self.seeds,
             directed=self.directed, max_steps=self.max_steps,
@@ -302,8 +348,7 @@ class HopBatchedCC(_HopBatched):
         super().__init__(log)
         self.max_steps = max_steps
 
-    def run(self, hop_times, windows):
-        hop_times, cols = self._fold_columns(hop_times)
+    def _dispatch_cols(self, cols, hop_times, windows):
         return run_cc_columns(
             self.tables, *cols, hop_times, windows,
             max_steps=self.max_steps,

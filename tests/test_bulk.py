@@ -33,15 +33,15 @@ def test_bulk_columns_match_eventlog_fold(seed):
         view = build_view(log, T)
         # vertex fold: alive set + latest times
         for i, vid in enumerate(view.vids[: view.n_active]):
-            assert v_alive[int(vid), j], (T, int(vid))
-            assert v_lat[int(vid), j] == view.v_latest_time[i], (T, int(vid))
-        assert int(v_alive[:, j].sum()) == view.n_active
+            assert v_alive[j, int(vid)], (T, int(vid))
+            assert v_lat[j, int(vid)] == view.v_latest_time[i], (T, int(vid))
+        assert int(v_alive[j].sum()) == view.n_active
         # edge fold: alive pairs + latest times, via the engine order
         got_pairs = {}
         for p in range(bulk.m):
-            if e_alive[p, j]:
+            if e_alive[j, p]:
                 got_pairs[(int(bulk.e_src[p]), int(bulk.e_dst[p]))] = \
-                    int(e_lat[p, j])
+                    int(e_lat[j, p])
         want_pairs = {}
         for p in range(view.m_active):
             want_pairs[(int(view.vids[view.e_src[p]]),
