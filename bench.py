@@ -558,24 +558,30 @@ PEAK_BF16_TFLOPS = 197.0
 
 def bench_scale_pagerank():
     """BASELINE.md's scale shape: Twitter-2010-like graph, windowed PageRank,
-    1-hour hops, single chip. ~5.3M vertices / 100M edge events (override
-    with RTPU_SCALE_V / RTPU_SCALE_E). Honest physics note: scalar PageRank
-    moves 4 bytes per edge endpoint via random access, so this is bound by
-    the chip's per-element gather rate — utilisation is reported so the
-    number is judgeable, not impressive."""
+    1-hour hops, single chip. ~5.3M vertices / 33.5M edge events by default
+    (override with RTPU_SCALE_V / RTPU_SCALE_E, e.g. 1<<27 = 134M).
+
+    The sweep is 128 (hop, window) views — 16 one-hour hops x 8 windows —
+    because 128 f32 columns fill the vector lanes: measured on this chip,
+    per-(view, iteration) cost drops 120x from C=8 to C=128 (row moves hit
+    bandwidth class instead of the per-element gather rate). Fold state
+    ships as base + per-hop deltas and is rebuilt ON DEVICE
+    (run_scale_columns): materialised [H, m_pad] columns cannot cross this
+    rig's ~20 MB/s host tunnel, and shipping O(delta) is the right design
+    at any link speed. Setup (upload + compile) is excluded from the timed
+    sweep and reported alongside; a same-size CPU-backend crosscheck rides
+    in the row when on the accelerator."""
     import os
 
     import jax
+    import jax.numpy as jnp
 
-    from raphtory_tpu.core.bulk import bulk_hop_columns
-    from raphtory_tpu.engine.hopbatch import run_columns
+    from raphtory_tpu.core.bulk import bulk_hop_deltas
+    from raphtory_tpu.engine.hopbatch import run_scale_columns
     from raphtory_tpu.utils.synth import gab_like_arrays
 
-    # Default sized so the SINGLE-CORE host (this image) folds it in ~1 min:
-    # 5.3M vertices / 33.5M edge events. The full Twitter-2010-scale config
-    # (RTPU_SCALE_E=100000000) is supported but its host-side radix fold
-    # alone takes ~10 min on one core — opt in explicitly. The CPU fallback
-    # (tunnel flap) shrinks further so a flap can't blow the artifact.
+    # CPU fallback (tunnel flap) shrinks so a flap can't blow the artifact;
+    # the same-size crosscheck sets RTPU_SCALE_* explicitly to override it
     shrunk = os.environ.get("RTPU_BENCH_DEVICE") == "cpu"
     n_v = int(os.environ.get("RTPU_SCALE_V",
                              1_000_000 if shrunk else 5_300_000))
@@ -587,55 +593,62 @@ def bench_scale_pagerank():
                                       seed=11, t_span=t_span)
     gen_s = _time.perf_counter() - g0
 
-    windows = [2_600_000, 86_400]     # month / day
     iters = 10
     T0 = int(0.8 * t_span)
-    hops = [T0 + 3_600, T0 + 7_200, T0 + 10_800]   # 1-hour hops
-    n_views = len(hops) * len(windows)
+    hops = [T0 + 3_600 * k for k in range(1, 17)]       # 16 one-hour hops
+    windows = [2_600_000, 1_209_600, 604_800, 259_200,  # month/2w/week/3d
+               86_400, 43_200, 21_600, 3_600]           # day/12h/6h/hour
+    n_views = len(hops) * len(windows)                  # 128 columns
 
-    # add-only bulk load (radix folds, core/bulk.py) feeding the columnar
-    # engine — the whole sweep is one dispatch of C-wide rows
     s0 = _time.perf_counter()
-    bulk, e_lat, e_alive, v_lat, v_alive = bulk_hop_columns(
+    bulk, base_e, base_v, d_e, d_v = bulk_hop_deltas(
         src, dst, times, hops, n_vertices=n_v)
     fold_s = _time.perf_counter() - s0
-    s0 = _time.perf_counter()
-    # device-put the fold columns ONCE (jnp.asarray on a device array is a
-    # no-op inside run_columns) so the timed region measures the sweep, not
-    # repeated host->device copies
-    import jax.numpy as jnp
 
-    cols = tuple(jnp.asarray(a) for a in (e_lat, e_alive, v_lat, v_alive))
+    s0 = _time.perf_counter()
+    # device-put the big inputs ONCE (jnp.asarray of a device array is a
+    # no-op inside run_scale_columns): the timed sweep measures the device
+    # program, not host->device copies
+    base_e = jax.device_put(jnp.asarray(base_e))
+    base_v = jax.device_put(jnp.asarray(base_v))
     statics = {"e_src_dev": jnp.asarray(bulk.e_src),
                "e_dst_dev": jnp.asarray(bulk.e_dst)}
-    warm, _ = run_columns(bulk, *cols, hops, windows,
-                          tol=1e-7, max_steps=iters, **statics)
+    kw = dict(tol=0.0, max_steps=iters, **statics)
+    warm, _ = run_scale_columns(bulk, base_e, base_v, d_e, d_v, hops,
+                                windows, **kw)
     _sync(warm)       # upload + compile
     setup_s = _time.perf_counter() - s0
     del warm
 
-    t0 = _time.perf_counter()
-    ranks, _ = run_columns(bulk, *cols, hops, windows,
-                           tol=1e-7, max_steps=iters, **statics)
-    _sync(ranks)
-    elapsed = _time.perf_counter() - t0
+    def once():
+        ranks, steps = run_scale_columns(bulk, base_e, base_v, d_e, d_v,
+                                         hops, windows, **kw)
+        return ranks, {}
+
+    # a same-size crosscheck subprocess runs ONE timed sweep — at this
+    # scale each CPU sweep is minutes, and one is proof enough
+    n_rep = 1 if os.environ.get("RTPU_CROSSCHECK") else 2
+    elapsed, repeats, _aux = _best_of(once, n=n_rep)
     m_pad, uniq = bulk.m_pad, bulk.m
-    engine = "bulk_radix_fold + hop_batched_columnar"
     # per iteration: C-wide payload rows read+write + index columns
     bytes_moved = iters * m_pad * (2 * n_views * 4 + 8)
     vps = n_views / elapsed
     return {
         "metric": ("scale windowed PageRank views/sec "
                    f"({n_v / 1e6:.1f}M v / {n_e / 1e6:.1f}M edge events, "
-                   "10 iters, 1-hour hops)"),
+                   "10 iters, 16 1-hour hops x 8 windows)"),
         "value": round(vps, 4),
         "unit": "views/sec",
         "vs_baseline": round(vps * REF_VIEW_S, 2),
         "detail": {
             "n_views": n_views,
-            "engine": engine,
+            "n_vertices": n_v,
+            "n_edge_events": n_e,
+            "engine": "bulk_radix_fold + device_rebuilt_scale_columns",
+            "timing": "best_of_2_sweeps_setup_excluded",
             "sweep_seconds": round(elapsed, 2),
-            "seconds_per_view": round(elapsed / n_views, 2),
+            "repeat_sweep_seconds": repeats,
+            "seconds_per_view": round(elapsed / n_views, 4),
             "bulk_fold_seconds": round(fold_s, 2),
             "upload_compile_seconds": round(setup_s, 2),
             "synth_seconds": round(gen_s, 2),
@@ -702,6 +715,8 @@ def bench_scale_features():
         "vs_baseline": 0.0,   # no reference analogue exists
         "detail": {
             "n_views": len(calls),
+            "n_vertices": n_v,
+            "n_edges": n_e,
             "sweep_seconds": round(elapsed, 2),
             "seconds_per_view": round(elapsed / len(calls), 3),
             "setup_seconds": round(setup_s, 2),
@@ -729,17 +744,21 @@ CONFIGS = {
 }
 
 
-def _cpu_crosscheck(timeout: float = 420.0) -> dict:
-    """Re-run the headline config in a subprocess pinned to the CPU backend —
-    proof alongside the accelerator number that the chip path is not losing
-    to the host fallback (round-3 verdict's central ask)."""
+def _cpu_crosscheck(config: str = "headline", timeout: float = 420.0,
+                    env: dict | None = None) -> dict:
+    """Re-run a config in a subprocess pinned to the CPU backend — proof
+    alongside the accelerator number that the chip path is not losing to
+    the host fallback (round-3 verdict's central ask). ``env`` overrides
+    (e.g. RTPU_SCALE_*) force the SAME problem size as the device run."""
+    import os
     import subprocess
 
     try:
         out = subprocess.run(
-            [sys.executable, __file__, "--config", "headline",
+            [sys.executable, __file__, "--config", config,
              "--device", "cpu", "--no-crosscheck"],
-            capture_output=True, text=True, timeout=timeout)
+            capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, **(env or {})})
         for line in reversed(out.stdout.strip().splitlines()):
             try:
                 row = json.loads(line)
@@ -824,6 +843,22 @@ def main():
             if (name == "headline" and device != "cpu"
                     and not args.no_crosscheck):
                 row["detail"]["cpu_crosscheck"] = _cpu_crosscheck()
+            if (name == "scale_pagerank" and device != "cpu"
+                    and not args.no_crosscheck and "error" not in row):
+                # SAME problem size on the CPU backend (the fallback shrink
+                # env must not apply, or the comparison is meaningless)
+                row["detail"]["cpu_same_size_crosscheck"] = _cpu_crosscheck(
+                    "scale_pagerank", timeout=1200.0,
+                    env={"RTPU_SCALE_V": str(row["detail"]["n_vertices"]),
+                         "RTPU_SCALE_E": str(row["detail"]["n_edge_events"]),
+                         "RTPU_CROSSCHECK": "1"})
+            if (name == "scale_features" and device != "cpu"
+                    and not args.no_crosscheck and "error" not in row):
+                row["detail"]["cpu_same_size_crosscheck"] = _cpu_crosscheck(
+                    "scale_features", timeout=1200.0,
+                    env={"RTPU_FEAT_V": str(row["detail"]["n_vertices"]),
+                         "RTPU_FEAT_E": str(row["detail"]["n_edges"]),
+                         "RTPU_CROSSCHECK": "1"})
         except Exception as e:
             row = {
                 "config": name,

@@ -67,18 +67,13 @@ def _run_last(sorted_keys: np.ndarray):
     return np.concatenate([change, [len(sorted_keys) - 1]])
 
 
-def bulk_hop_columns(src, dst, times, hop_times, n_vertices: int | None = None):
-    """Load an ADD-ONLY edge stream and fold it at each hop time.
+def _bulk_load(src, dst, times, hop_times, n_vertices):
+    """Shared bulk-loader head: validation + ONE global pair radix.
 
-    ``src``/``dst``: dense non-negative int vertex ids (< 2^31);
-    ``times``: non-decreasing event times (sort the stream first if not);
-    ``hop_times``: ascending fold timestamps.
-
-    Returns ``(bulk, e_lat, e_alive, v_lat, v_alive)`` with the column
-    arrays shaped hop-major ``[H, m_pad]`` / ``[H, n_pad]`` in the bulk
-    graph's engine order — exactly what ``engine.hopbatch.run_columns``
-    consumes (row ``j`` = fold state at ``hop_times[j]``).
-    """
+    Returns ``(bulk, src, dst, times, hop_times, pos_of_event)`` where
+    ``pos_of_event[i]`` is event i's ENGINE position — recovered from the
+    single full-stream sort, so per-hop folds never binary-search the pair
+    table again."""
     src = np.ascontiguousarray(src, np.int64)
     dst = np.ascontiguousarray(dst, np.int64)
     times = np.ascontiguousarray(times, np.int64)
@@ -104,19 +99,64 @@ def bulk_hop_columns(src, dst, times, hop_times, n_vertices: int | None = None):
         raise ValueError(
             f"vertex id {id_max} >= n_vertices ({n_v})")
 
-    tdtype = np.int32
     packed = (src.astype(np.uint64) << np.uint64(32)) | dst.astype(np.uint64)
     order_all = _native.radix_argsort_u64(packed)
     sp = packed[order_all]
     uniq = sp[_run_last(sp)]          # last-of-run == unique, sorted
-    bulk = BulkGraph(n_v, uniq, tdtype)
-    # pair rank per EVENT, recovered from the one full-stream sort — the
-    # per-slice folds below then never binary-search the pair table
+    bulk = BulkGraph(n_v, uniq, np.int32)
     starts = np.ones(len(sp), bool)
     starts[1:] = sp[1:] != sp[:-1]
     rank_sorted = np.cumsum(starts) - 1
-    rank_of_event = np.empty(len(sp), np.int64)
-    rank_of_event[order_all] = rank_sorted
+    pos_of_event = np.empty(len(sp), np.int64)
+    pos_of_event[order_all] = bulk.eng_of_rank[rank_sorted]
+    return bulk, src, dst, times, hop_times, pos_of_event
+
+
+def _slice_fold(lat_e, lat_v, src, dst, times, pos_of_event, prev, hi,
+                tdtype, al_e=None, al_v=None):
+    """Fold the time-ascending event slice [prev, hi) into running
+    engine-order rows by DIRECT fancy assignment: numpy integer-array
+    assignment keeps the last value for duplicate indices, so "latest
+    event <= T" is just "write in stream order" — no per-slice sort.
+    Endpoints interleave so the flattened vertex write order stays
+    time-ascending. Returns the slice's raw (pos, ts, vk, vts) updates for
+    callers that ship them as deltas instead of folding on host
+    (``lat_e``/``lat_v`` may be None to skip the writes entirely)."""
+    pos = pos_of_event[prev:hi]
+    ts = times[prev:hi].astype(tdtype)
+    vk = np.empty(2 * (hi - prev), np.int64)
+    vk[0::2] = src[prev:hi]
+    vk[1::2] = dst[prev:hi]
+    vts = np.repeat(ts, 2)
+    if lat_e is not None:
+        lat_e[pos] = ts
+        lat_v[vk] = vts
+    if al_e is not None:
+        al_e[pos] = True
+        al_v[vk] = True
+    return pos, ts, vk, vts
+
+
+def bulk_hop_columns(src, dst, times, hop_times, n_vertices: int | None = None):
+    """Load an ADD-ONLY edge stream and fold it at each hop time.
+
+    ``src``/``dst``: dense non-negative int vertex ids (< 2^31);
+    ``times``: non-decreasing event times (sort the stream first if not);
+    ``hop_times``: ascending fold timestamps.
+
+    Returns ``(bulk, e_lat, e_alive, v_lat, v_alive)`` with the column
+    arrays shaped hop-major ``[H, m_pad]`` / ``[H, n_pad]`` in the bulk
+    graph's engine order — exactly what ``engine.hopbatch.run_columns``
+    consumes (row ``j`` = fold state at ``hop_times[j]``).
+
+    Per-slice folds are DIRECT fancy assignments: the stream is
+    time-ascending and numpy integer-array assignment keeps the last value
+    for duplicate indices, so "latest event <= T" is just "write in stream
+    order" — no per-slice sort at all.
+    """
+    bulk, src, dst, times, hop_times, pos_of_event = _bulk_load(
+        src, dst, times, hop_times, n_vertices)
+    tdtype = bulk.tdtype
 
     H = len(hop_times)
     e_lat = np.full((H, bulk.m_pad), bulk.tmin, tdtype)
@@ -133,26 +173,8 @@ def bulk_hop_columns(src, dst, times, hop_times, n_vertices: int | None = None):
     for j, T in enumerate(hop_times):
         hi = int(np.searchsorted(times, T, side="right"))
         if hi > prev:
-            ps = rank_of_event[prev:hi].astype(np.uint64)
-            ts = times[prev:hi]
-            o = _native.radix_argsort_u64(ps)        # stable: time-asc in run
-            pss, tss = ps[o], ts[o]
-            last = _run_last(pss)
-            pos = bulk.eng_of_rank[pss[last].astype(np.int64)]
-            lat_e[pos] = tss[last].astype(tdtype)
-            al_e[pos] = True
-            # vertex fold: interleave endpoints so the concatenated stream
-            # stays time-ascending (both endpoints of an event adjacent)
-            vk = np.empty(2 * (hi - prev), np.uint64)
-            vk[0::2] = src[prev:hi].astype(np.uint64)
-            vk[1::2] = dst[prev:hi].astype(np.uint64)
-            vt = np.repeat(ts, 2)
-            ov = _native.radix_argsort_u64(vk)
-            vks, vts = vk[ov], vt[ov]
-            lastv = _run_last(vks)
-            vid = vks[lastv].astype(np.int64)
-            lat_v[vid] = vts[lastv].astype(tdtype)
-            al_v[vid] = True
+            _slice_fold(lat_e, lat_v, src, dst, times, pos_of_event,
+                        prev, hi, tdtype, al_e=al_e, al_v=al_v)
             prev = hi
         e_lat[j] = lat_e          # contiguous row memcpy in this layout
         e_alive[j] = al_e
@@ -160,3 +182,41 @@ def bulk_hop_columns(src, dst, times, hop_times, n_vertices: int | None = None):
         v_alive[j] = al_v
 
     return bulk, e_lat, e_alive, v_lat, v_alive
+
+
+def bulk_hop_deltas(src, dst, times, hop_times, n_vertices: int | None = None):
+    """Like ``bulk_hop_columns`` but O(base + deltas) output for
+    DEVICE-SIDE column reconstruction (``engine.hopbatch.run_scale_columns``)
+    — at 10^8-edge scale the materialised ``[H, m_pad]`` columns cannot
+    cross the host link, so hop 0's full fold state ships once and each
+    later hop ships only its raw update pairs (the device scatter-max
+    dedupes; times ascend so max == latest).
+
+    Returns ``(bulk, base_e_lat, base_v_lat, deltas_e, deltas_v)`` where
+    ``base_*`` are the engine-order fold rows at ``hop_times[0]`` (int32,
+    INT32_MIN = never seen — add-only, so alive == lat >= 0) and
+    ``deltas_*[j]`` is hop j's ``(positions, times)`` pair (empty for
+    j = 0, the base)."""
+    bulk, src, dst, times, hop_times, pos_of_event = _bulk_load(
+        src, dst, times, hop_times, n_vertices)
+    tdtype = bulk.tdtype
+
+    base_e = np.full(bulk.m_pad, bulk.tmin, tdtype)
+    base_v = np.full(bulk.n_pad, bulk.tmin, tdtype)
+    empty = (np.empty(0, np.int32), np.empty(0, tdtype))
+    deltas_e, deltas_v = [empty], [empty]
+
+    hi0 = int(np.searchsorted(times, hop_times[0], side="right"))
+    _slice_fold(base_e, base_v, src, dst, times, pos_of_event, 0, hi0,
+                tdtype)
+
+    # later hops: raw update pairs only — the folds happen on device
+    prev = hi0
+    for T in hop_times[1:]:
+        hi = int(np.searchsorted(times, T, side="right"))
+        pos, ts, vk, vts = _slice_fold(
+            None, None, src, dst, times, pos_of_event, prev, hi, tdtype)
+        deltas_e.append((pos.astype(np.int32), ts))
+        deltas_v.append((vk.astype(np.int32), vts))
+        prev = hi
+    return bulk, base_e, base_v, deltas_e, deltas_v

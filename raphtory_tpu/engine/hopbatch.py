@@ -52,6 +52,45 @@ def _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
     return me, mv
 
 
+def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
+                      tol: float, max_steps: int):
+    """Power iteration over per-column masks ``me [m_pad, C]`` /
+    ``mv [n_pad, C]`` — dangling redistribution, tol halting with
+    converged-column freeze; semantics of ``algorithms/pagerank.py``.
+    Shared by the general columnar kernel and the scale (device-built
+    columns) kernel."""
+    C = me.shape[1]
+    mef = me.astype(jnp.float32)                    # [m_pad, C]
+    # out-degree per column: combine at src (unsorted scatter, once)
+    out_deg = jax.ops.segment_sum(mef, e_src, num_segments=n_pad)
+    n_act = jnp.maximum(jnp.sum(mv.astype(jnp.float32), axis=0), 1.0)
+    r0 = jnp.where(mv, 1.0 / n_act[None, :], 0.0).astype(jnp.float32)
+    inv_deg = 1.0 / jnp.maximum(out_deg, 1.0)
+    dangling_mask = mv & (out_deg == 0)
+
+    def body(carry):
+        step, r, halted = carry
+        payload = (r * inv_deg)[e_src, :] * mef     # row gather [m, C]
+        agg = jax.ops.segment_sum(
+            payload, e_dst, num_segments=n_pad, indices_are_sorted=True)
+        dangling = jnp.sum(jnp.where(dangling_mask, r, 0.0), axis=0)
+        new = ((1.0 - damping) / n_act[None, :]
+               + damping * (agg + dangling[None, :] / n_act[None, :]))
+        new = jnp.where(mv, new, 0.0).astype(jnp.float32)
+        col_done = jnp.all((jnp.abs(new - r) < tol) | ~mv, axis=0)
+        # freeze converged columns
+        new = jnp.where(halted[None, :], r, new)
+        return step + 1, new, halted | col_done
+
+    def cond(carry):
+        step, _, halted = carry
+        return (step < max_steps) & ~jnp.all(halted)
+
+    steps, r, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), r0, jnp.zeros((C,), bool)))
+    return r.T, steps   # [C, n_pad], hop-major columns
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
               tol: float, max_steps: int, tdt: str):
@@ -61,35 +100,8 @@ def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
             hop_of_col, T_col, w_col):
         me, mv = _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
                                hop_of_col, T_col, w_col)
-        mef = me.astype(jnp.float32)                    # [m_pad, C]
-        # out-degree per column: combine at src (unsorted scatter, once)
-        out_deg = jax.ops.segment_sum(mef, e_src, num_segments=n_pad)
-        n_act = jnp.maximum(jnp.sum(mv.astype(jnp.float32), axis=0), 1.0)
-        r0 = jnp.where(mv, 1.0 / n_act[None, :], 0.0).astype(jnp.float32)
-        inv_deg = 1.0 / jnp.maximum(out_deg, 1.0)
-        dangling_mask = mv & (out_deg == 0)
-
-        def body(carry):
-            step, r, halted = carry
-            payload = (r * inv_deg)[e_src, :] * mef     # row gather [m, C]
-            agg = jax.ops.segment_sum(
-                payload, e_dst, num_segments=n_pad, indices_are_sorted=True)
-            dangling = jnp.sum(jnp.where(dangling_mask, r, 0.0), axis=0)
-            new = ((1.0 - damping) / n_act[None, :]
-                   + damping * (agg + dangling[None, :] / n_act[None, :]))
-            new = jnp.where(mv, new, 0.0).astype(jnp.float32)
-            col_done = jnp.all((jnp.abs(new - r) < tol) | ~mv, axis=0)
-            # freeze converged columns
-            new = jnp.where(halted[None, :], r, new)
-            return step + 1, new, halted | col_done
-
-        def cond(carry):
-            step, _, halted = carry
-            return (step < max_steps) & ~jnp.all(halted)
-
-        steps, r, _ = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), r0, jnp.zeros((C,), bool)))
-        return r.T, steps   # [C, n_pad], hop-major columns
+        return _pagerank_columns(me, mv, e_src, e_dst, n_pad,
+                                 damping, tol, max_steps)
 
     return jax.jit(run)
 
@@ -365,6 +377,79 @@ def _dispatch_columns(runner, tables, cols, hop_of_col, T_col,
         *(jnp.asarray(a) for a in cols),
         jnp.asarray(hop_of_col), jnp.asarray(T_col), jnp.asarray(w_col),
         *(jnp.asarray(a) for a in extra))
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_scale(n_pad: int, m_pad: int, H: int, W: int, U_e: int,
+                    U_v: int, damping: float, tol: float, max_steps: int):
+    """Scale variant of the columnar PageRank: per-hop fold state is
+    REBUILT ON DEVICE from the base state plus per-hop update lists, so a
+    sweep ships O(base + deltas) bytes instead of O(m_pad * H) — at
+    10^8-edge scale the ``[H, m_pad]`` columns cannot cross the host link.
+
+    Add-only streams only (``core/bulk.py`` contract): alive == ever-seen,
+    so every mask is ONE threshold compare ``lat >= thr`` with
+    ``thr = max(T - w, 0)`` (windowed) or 0 (unwindowed), and hop state is
+    a running scatter-max of update times. Update lists are (pos, t) pairs
+    padded with (0, INT32_MIN) — a max no-op."""
+    TMIN = jnp.iinfo(jnp.int32).min
+
+    def run(e_src, e_dst, base_e, base_v, de_pos, de_t, dv_pos, dv_t, thr):
+        def hop_masks(base, d_pos, d_t):
+            cur, cols = base, []
+            for h in range(H):     # H static and small: unrolled
+                cur = cur.at[d_pos[h]].max(d_t[h])
+                cols.append(cur[:, None] >= thr[h * W:(h + 1) * W][None, :])
+            return jnp.concatenate(cols, axis=1)   # [len, H*W] hop-major
+        me = hop_masks(base_e, de_pos, de_t)
+        mv = hop_masks(base_v, dv_pos, dv_t)
+        return _pagerank_columns(me, mv, e_src, e_dst, n_pad,
+                                 damping, tol, max_steps)
+
+    return jax.jit(run)
+
+
+def run_scale_columns(bulk, base_e, base_v, deltas_e, deltas_v, hop_times,
+                      windows, *, damping: float = 0.85, tol: float = 0.0,
+                      max_steps: int = 20, e_src_dev=None, e_dst_dev=None):
+    """Columnar PageRank over ``core.bulk.bulk_hop_deltas`` output: uploads
+    the base fold rows and per-hop update lists, rebuilds hop state on
+    device, runs every (hop, window) view as one column. Returns
+    ``(ranks [H*W, n_pad] hop-major, steps)``; unwindowed views use a
+    negative window (same convention as ``run_columns``)."""
+    H = len(hop_times)
+    wlist = normalize_windows(windows)
+    W = len(wlist)
+    thr = np.zeros(H * W, np.int32)
+    for j, T in enumerate(int(x) for x in hop_times):
+        for i, w in enumerate(wlist):
+            thr[j * W + i] = 0 if w < 0 else max(int(T) - int(w), 0)
+
+    def pad_deltas(deltas, U):
+        pos = np.zeros((H, U), np.int32)
+        t = np.full((H, U), np.iinfo(np.int32).min, np.int32)
+        for h, (p, tt) in enumerate(deltas):
+            if len(p) > U:
+                raise ValueError(f"delta {h} exceeds pad {U}")
+            pos[h, : len(p)] = p
+            t[h, : len(p)] = tt
+        return pos, t
+
+    def pad_for(deltas):
+        longest = max((len(p) for p, _ in deltas), default=1)
+        return max(1024, 1 << int(np.ceil(np.log2(max(longest, 1)))))
+
+    U_e, U_v = pad_for(deltas_e), pad_for(deltas_v)
+    de_pos, de_t = pad_deltas(deltas_e, U_e)
+    dv_pos, dv_t = pad_deltas(deltas_v, U_v)
+    runner = _compiled_scale(bulk.n_pad, bulk.m_pad, H, W, U_e, U_v,
+                             float(damping), float(tol), int(max_steps))
+    return runner(
+        e_src_dev if e_src_dev is not None else jnp.asarray(bulk.e_src),
+        e_dst_dev if e_dst_dev is not None else jnp.asarray(bulk.e_dst),
+        jnp.asarray(base_e), jnp.asarray(base_v),
+        jnp.asarray(de_pos), jnp.asarray(de_t),
+        jnp.asarray(dv_pos), jnp.asarray(dv_t), jnp.asarray(thr))
 
 
 def _column_layout(hop_times, windows):

@@ -107,3 +107,23 @@ def test_bulk_rejects_out_of_range_ids():
     src, dst, times = _stream(2, n_events=100, n_ids=50)
     with pytest.raises(ValueError, match=">= n_vertices"):
         bulk_hop_columns(src, dst, times, [50], n_vertices=10)
+
+
+def test_bulk_deltas_match_columns_scale_engine():
+    """run_scale_columns (base+deltas shipped, hop state rebuilt on device)
+    must equal run_columns over materialised bulk_hop_columns for the same
+    add-only stream — windowed and unwindowed columns alike."""
+    from raphtory_tpu.core.bulk import bulk_hop_deltas
+    from raphtory_tpu.engine.hopbatch import run_scale_columns
+
+    src, dst, times = _stream(4, n_events=2500, n_ids=60)
+    hops = [80, 150, 220, 299]
+    windows = [100000, 120, 40, None]
+    bulk, *cols = bulk_hop_columns(src, dst, times, hops)
+    want, _ = run_columns(bulk, *cols, hops, windows, tol=0.0, max_steps=12)
+
+    bulk2, base_e, base_v, d_e, d_v = bulk_hop_deltas(src, dst, times, hops)
+    got, _ = run_scale_columns(bulk2, base_e, base_v, d_e, d_v, hops,
+                               windows, tol=0.0, max_steps=12)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               atol=1e-6, rtol=0)
