@@ -84,6 +84,27 @@ def main():
     harness(scan5(lambda c: (c @ c) * 1e-4 + c * 0.5), a,
             label="matmul_4096", detail="137 GFLOP/step, MXU")
 
+    # column-width scaling of the columnar graph kernel (gather + sorted
+    # segment_sum over [m, C] rows): C=128 fills the f32 vector lanes and
+    # turns the per-element gather rate into bandwidth-class row moves —
+    # measured ~120x cheaper per (column, element) than C=8 at 33.5M edges.
+    # This is the basis for the 128-view scale sweep
+    # (engine/hopbatch.run_scale_columns). C=64 is skipped: it crashes this
+    # backend's remote compile helper (INTERNAL, tpu_compile_helper exit 1).
+    m, n = 1 << 22, 1 << 20
+    esrc = jnp.asarray(rng.integers(0, n, m).astype(np.int32))
+    edst = jnp.asarray(np.sort(rng.integers(0, n, m)).astype(np.int32))
+    for C in (8, 32, 128):
+        r0 = jnp.asarray(rng.random((n, C), dtype=np.float32))
+        ms = harness(
+            scan5(lambda c, s, d: 0.9 * jax.ops.segment_sum(
+                c[s, :], d, num_segments=n, indices_are_sorted=True)
+                + 0.1 / n),
+            r0, esrc, edst, label=f"columnar_C{C}_4M_edges",
+            detail="gather + sorted segment_sum over [4M, C] rows")
+        print(json.dumps({"primitive": f"columnar_C{C}_per_col_elem_ns",
+                          "value": round(1e6 * ms / m / C, 3)}))
+
 
 if __name__ == "__main__":
     main()
