@@ -1,0 +1,108 @@
+"""Golden-value algorithm tests against NetworkX (SURVEY §4: the test
+pyramid the reference lacks needs external oracles, not just
+engine-vs-engine equivalence — all our engines could share one bug)."""
+
+import networkx as nx
+import numpy as np
+import pytest
+
+from raphtory_tpu.algorithms import (BFS, SSSP, ConnectedComponents,
+                                     DegreeBasic, PageRank)
+from raphtory_tpu.core.events import EventLog
+from raphtory_tpu.core.snapshot import build_view
+from raphtory_tpu.engine import bsp
+from raphtory_tpu.utils.synth import ldbc_like_log, random_update_stream
+
+
+def to_networkx(view, weight_prop=None):
+    """Oracle-side mirror of a GraphView's alive vertex/edge sets (absent
+    weights default to 1.0, matching SSSP.message)."""
+    w_arr = view.edge_prop(weight_prop) if weight_prop else None
+    G = nx.DiGraph()
+    for i in range(view.n_active):
+        G.add_node(int(view.vids[i]))
+    for p in range(view.m_active):
+        attrs = {}
+        if w_arr is not None:
+            w = float(w_arr[p])
+            attrs["weight"] = 1.0 if np.isnan(w) else w
+        G.add_edge(int(view.vids[view.e_src[p]]),
+                   int(view.vids[view.e_dst[p]]), **attrs)
+    return G
+
+
+@pytest.fixture(scope="module")
+def graph():
+    log = EventLog()
+    log.append_batch(*random_update_stream(
+        3000, id_pool=150, seed=13, t_end=1000,
+        mix=(0.25, 0.55, 0.08, 0.12)))
+    view = build_view(log, 900)
+    return view, to_networkx(view)
+
+
+def test_pagerank_matches_networkx(graph):
+    view, G = graph
+    got, _ = bsp.run(PageRank(max_steps=200, tol=1e-12), view)
+    got = np.asarray(got)
+    want = nx.pagerank(G, alpha=0.85, max_iter=500, tol=1e-12)
+    for i in range(view.n_active):
+        assert got[i] == pytest.approx(want[int(view.vids[i])], abs=2e-6), \
+            int(view.vids[i])
+
+
+def test_connected_components_match_networkx(graph):
+    view, G = graph
+    got, _ = bsp.run(ConnectedComponents(max_steps=200), view)
+    got = np.asarray(got)
+    ours = {}
+    for i in range(view.n_active):
+        ours.setdefault(int(got[i]), set()).add(int(view.vids[i]))
+    theirs = list(nx.connected_components(G.to_undirected()))
+    assert sorted(map(sorted, ours.values())) == \
+        sorted(map(sorted, theirs))
+
+
+def test_bfs_matches_networkx(graph):
+    view, G = graph
+    seeds = tuple(int(v) for v in view.vids[:3])
+    dist, _ = bsp.run(BFS(seeds=seeds, directed=False, max_steps=200), view)
+    dist = np.asarray(dist)
+    U = G.to_undirected()
+    want = {}
+    for s in seeds:
+        for v, d in nx.single_source_shortest_path_length(U, s).items():
+            want[v] = min(want.get(v, np.inf), d)
+    for i in range(view.n_active):
+        vid = int(view.vids[i])
+        w = want.get(vid, np.inf)
+        g = float(dist[i])
+        assert (np.isinf(w) and np.isinf(g)) or g == w, (vid, g, w)
+
+
+def test_weighted_sssp_matches_networkx_dijkstra():
+    log = ldbc_like_log(n_persons=120, n_knows=900, t_span=1000,
+                        weighted=True, seed=7)
+    view = build_view(log, 1000)
+    G = to_networkx(view, weight_prop="weight")
+    seeds = tuple(int(v) for v in view.vids[:2])
+    dist, _ = bsp.run(SSSP(seeds=seeds, weight_prop="weight", directed=True,
+                           max_steps=300), view)
+    dist = np.asarray(dist)
+    want = nx.multi_source_dijkstra_path_length(G, set(seeds),
+                                                weight="weight")
+    for i in range(view.n_active):
+        vid = int(view.vids[i])
+        w = want.get(vid, np.inf)
+        g = float(dist[i])
+        assert (np.isinf(w) and np.isinf(g)) or \
+            g == pytest.approx(w, abs=1e-4), (vid, g, w)
+
+
+def test_degrees_match_networkx(graph):
+    view, G = graph
+    got, _ = bsp.run(DegreeBasic(), view)
+    for i in range(view.n_active):
+        vid = int(view.vids[i])
+        assert int(np.asarray(got["in"])[i]) == G.in_degree(vid)
+        assert int(np.asarray(got["out"])[i]) == G.out_degree(vid)
