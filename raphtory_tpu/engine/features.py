@@ -47,24 +47,29 @@ def _compiled_propagate(n_pad: int, m_pad: int, chunk: int, F: int,
         msk_c = mask.reshape(C, chunk)
         ones = jnp.ones((chunk,), jnp.float32)
 
+        # masked in-degree is round-invariant — one per-element pass total,
+        # not one per round
+        def deg_body(deg, ins):
+            d, mk = ins
+            return deg + jax.ops.segment_sum(
+                jnp.where(mk, ones, 0.0), d, num_segments=n_pad,
+                indices_are_sorted=True), None
+
+        deg, _ = jax.lax.scan(deg_body, jnp.zeros((n_pad,), jnp.float32),
+                              (dst_c, msk_c))
+        inv_deg = 1.0 / jnp.maximum(deg, 1.0)
+
         def one_round(H, _):
-            def chunk_body(acc, ins):
+            def chunk_body(agg, ins):
                 s, d, mk = ins
                 G = jnp.where(mk[:, None], H[s, :], 0.0)     # row-tile gather
-                agg, deg = acc
-                agg = agg + jax.ops.segment_sum(
-                    G, d, num_segments=n_pad, indices_are_sorted=True)
-                deg = deg + jax.ops.segment_sum(
-                    jnp.where(mk, ones, 0.0), d, num_segments=n_pad,
-                    indices_are_sorted=True)
-                return (agg, deg), None
+                return agg + jax.ops.segment_sum(
+                    G, d, num_segments=n_pad, indices_are_sorted=True), None
 
-            (agg, deg), _ = jax.lax.scan(
-                chunk_body,
-                (jnp.zeros((n_pad, F), jnp.float32),
-                 jnp.zeros((n_pad,), jnp.float32)),
+            agg, _ = jax.lax.scan(
+                chunk_body, jnp.zeros((n_pad, F), jnp.float32),
                 (src_c, dst_c, msk_c))
-            H2 = agg / jnp.maximum(deg, 1.0)[:, None]
+            H2 = agg * inv_deg[:, None]
             H2 = self_weight * H + (1.0 - self_weight) * H2
             # row L2 normalise keeps magnitudes bounded across rounds
             norm = jnp.sqrt(jnp.sum(H2 * H2, axis=1, keepdims=True))
@@ -118,11 +123,13 @@ class FeatureAggregator:
     def traffic_bytes(self, rounds: int) -> int:
         """Approximate HBM bytes per propagate call (for utilisation
         reporting): per round, the edge axis streams a gathered F-row and
-        writes it once into the accumulator, plus index/mask columns."""
+        writes it once into the accumulator, plus index/mask columns; the
+        masked-degree pass runs ONCE per call (round-invariant)."""
         per_edge = 2 * self.F * 4 + 2 * 4 + 1   # gather+scatter rows, ids, mask
         per_vertex = 3 * self.F * 4             # acc read+write, H read
-        return rounds * (self.ds.m_pad * per_edge
-                         + self.ds.n_pad * per_vertex)
+        deg_pass = self.ds.m_pad * (4 + 1)      # dst ids + mask, one pass
+        return deg_pass + rounds * (self.ds.m_pad * per_edge
+                                    + self.ds.n_pad * per_vertex)
 
     def flops(self, rounds: int) -> int:
         """Adds/multiplies per propagate call (mean-aggregate + mix + norm)."""

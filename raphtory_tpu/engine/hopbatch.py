@@ -31,9 +31,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import logging
+
 from ..core.events import EventLog
 from ..core.sweep import SweepBuilder
 from .device_sweep import GlobalTables, normalize_windows
+
+_log = logging.getLogger(__name__)
 
 
 def _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
@@ -53,18 +57,31 @@ def _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
 
 
 def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
-                      tol: float, max_steps: int):
+                      tol: float, max_steps: int, r_init=None):
     """Power iteration over per-column masks ``me [m_pad, C]`` /
     ``mv [n_pad, C]`` — dangling redistribution, tol halting with
     converged-column freeze; semantics of ``algorithms/pagerank.py``.
     Shared by the general columnar kernel and the scale (device-built
-    columns) kernel."""
+    columns) kernel.
+
+    ``r_init`` (optional ``[n_pad, C]``) warm-starts the iteration: the
+    update is a contraction, so ANY masked positive start converges to the
+    SAME fixed point — a near-solution (the previous hop's ranks) just
+    gets there in a few steps instead of max_steps. Each column is masked
+    to its own alive set, floored so newly-alive vertices get mass, and
+    renormalised."""
     C = me.shape[1]
     mef = me.astype(jnp.float32)                    # [m_pad, C]
     # out-degree per column: combine at src (unsorted scatter, once)
     out_deg = jax.ops.segment_sum(mef, e_src, num_segments=n_pad)
     n_act = jnp.maximum(jnp.sum(mv.astype(jnp.float32), axis=0), 1.0)
     r0 = jnp.where(mv, 1.0 / n_act[None, :], 0.0).astype(jnp.float32)
+    if r_init is not None:
+        warm = jnp.where(mv, jnp.maximum(r_init, 0.0), 0.0)
+        warm = warm + jnp.where(mv, 0.1 / n_act[None, :], 0.0)
+        warm = warm / jnp.maximum(jnp.sum(warm, axis=0, keepdims=True),
+                                  1e-30)
+        r0 = warm.astype(jnp.float32)
     inv_deg = 1.0 / jnp.maximum(out_deg, 1.0)
     dangling_mask = mv & (out_deg == 0)
 
@@ -93,15 +110,16 @@ def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
 
 @functools.lru_cache(maxsize=64)
 def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
-              tol: float, max_steps: int, tdt: str):
+              tol: float, max_steps: int, tdt: str, warm: bool = False):
     tdt = jnp.dtype(tdt)
 
     def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
-            hop_of_col, T_col, w_col):
+            hop_of_col, T_col, w_col, *rest):
         me, mv = _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
                                hop_of_col, T_col, w_col)
         return _pagerank_columns(me, mv, e_src, e_dst, n_pad,
-                                 damping, tol, max_steps)
+                                 damping, tol, max_steps,
+                                 r_init=rest[0] if warm else None)
 
     return jax.jit(run)
 
@@ -240,24 +258,53 @@ class _HopBatched:
         self._e_src = jnp.asarray(self.tables.e_src)
         self._e_dst = jnp.asarray(self.tables.e_dst)
 
-    def _dispatch_cols(self, cols, hop_times, windows):
+    #: set True by subclasses whose iteration is a contraction (safe to
+    #: warm-start from the previous chunk's solution)
+    supports_warm_start = False
+
+    def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
         raise NotImplementedError
 
-    def run(self, hop_times, windows, chunks: int = 1):
+    def run(self, hop_times, windows, chunks: int = 1,
+            warm_start: bool = False):
+        """``chunks=k`` pipelines the sweep; ``warm_start=True``
+        additionally initialises each chunk's columns from the previous
+        chunk's LAST-hop ranks (same fixed point, reached in far fewer
+        steps when consecutive hops differ little). Warm-started results
+        agree with cold ones to the solver tolerance, not bitwise."""
+        if warm_start and not self.supports_warm_start:
+            raise ValueError(
+                f"{type(self).__name__} cannot warm-start: its superstep "
+                "is not a contraction (stale state would be wrong, not "
+                "just slower)")
         hop_times = [int(x) for x in hop_times]
         chunks = max(1, min(int(chunks), len(hop_times)))
         if chunks == 1 or len(hop_times) % chunks:
             # unequal groups would compile one program per distinct size —
             # pipeline only when the split is clean
+            if warm_start and chunks > 1:
+                _log.warning(
+                    "%d hops do not split into %d equal chunks — running "
+                    "one cold dispatch (warm_start has no effect)",
+                    len(hop_times), chunks)
             hop_times, cols = self._fold_columns(hop_times)
             return self._dispatch_cols(cols, hop_times, windows)
         per = len(hop_times) // chunks
+        W = len(normalize_windows(windows))
         outs = []
         steps = jnp.int32(0)
         for c in range(chunks):
             group = hop_times[c * per: (c + 1) * per]
             group, cols = self._fold_columns(group)
-            out, st = self._dispatch_cols(cols, group, windows)  # async
+            r_init = None
+            if warm_start and outs:
+                # previous chunk's last hop: rows [-W:] are its W windowed
+                # columns (hop-major); tile per hop of this group. Lazy
+                # device values — the host pipeline stays async
+                tail = outs[-1][-W:]                       # [W, n_pad]
+                r_init = jnp.tile(tail, (per, 1)).T        # [n_pad, per*W]
+            out, st = self._dispatch_cols(cols, group, windows,
+                                          r_init=r_init)  # async
             outs.append(out)
             steps = jnp.maximum(steps, st)
         return jnp.concatenate(outs, axis=0), steps
@@ -322,16 +369,18 @@ class HopBatchedPageRank(_HopBatched):
     global dense vertex space (``self.tables.uv``).
     """
 
+    supports_warm_start = True   # power iteration is a contraction
+
     def __init__(self, log: EventLog, damping: float = 0.85,
                  tol: float = 1e-7, max_steps: int = 20):
         super().__init__(log)
         self.damping, self.tol, self.max_steps = damping, tol, max_steps
 
-    def _dispatch_cols(self, cols, hop_times, windows):
+    def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
         return run_columns(
             self.tables, *cols, hop_times, windows,
             damping=self.damping, tol=self.tol, max_steps=self.max_steps,
-            e_src_dev=self._e_src, e_dst_dev=self._e_dst)
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst, r_init=r_init)
 
 
 class HopBatchedBFS(_HopBatched):
@@ -345,7 +394,8 @@ class HopBatchedBFS(_HopBatched):
         self.directed = directed
         self.max_steps = max_steps
 
-    def _dispatch_cols(self, cols, hop_times, windows):
+    def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
+        assert r_init is None   # guarded by supports_warm_start
         return run_bfs_columns(
             self.tables, *cols, hop_times, windows, self.seeds,
             directed=self.directed, max_steps=self.max_steps,
@@ -360,7 +410,8 @@ class HopBatchedCC(_HopBatched):
         super().__init__(log)
         self.max_steps = max_steps
 
-    def _dispatch_cols(self, cols, hop_times, windows):
+    def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
+        assert r_init is None   # guarded by supports_warm_start
         return run_cc_columns(
             self.tables, *cols, hop_times, windows,
             max_steps=self.max_steps,
@@ -465,15 +516,20 @@ def _column_layout(hop_times, windows):
 
 def run_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times, windows,
                 *, damping: float = 0.85, tol: float = 1e-7,
-                max_steps: int = 20, e_src_dev=None, e_dst_dev=None):
+                max_steps: int = 20, e_src_dev=None, e_dst_dev=None,
+                r_init=None):
     """Dispatch the columnar PageRank over prebuilt per-hop fold columns —
     shared by the incremental-fold class above and the add-only bulk loader
     (``core/bulk.bulk_hop_columns``). `tables` needs the GlobalTables /
-    BulkGraph surface (n_pad, m_pad, e_src, e_dst, tdtype)."""
+    BulkGraph surface (n_pad, m_pad, e_src, e_dst, tdtype). ``r_init``
+    ([n_pad, C], device) warm-starts the power iteration — see
+    ``_pagerank_columns``."""
     H, C, hop_of_col, T_col, w_col = _column_layout(hop_times, windows)
     runner = _compiled(tables.n_pad, tables.m_pad, H, C, float(damping),
                        float(tol), int(max_steps),
-                       np.dtype(tables.tdtype).name)
+                       np.dtype(tables.tdtype).name, r_init is not None)
+    extra = () if r_init is None else (r_init,)
     return _dispatch_columns(runner, tables,
                              (e_lat, e_alive, v_lat, v_alive),
-                             hop_of_col, T_col, w_col, e_src_dev, e_dst_dev)
+                             hop_of_col, T_col, w_col, e_src_dev, e_dst_dev,
+                             *extra)

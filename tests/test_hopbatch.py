@@ -164,3 +164,23 @@ def test_hopbatch_uneven_chunks_fall_back():
     two = np.asarray(HopBatchedPageRank(log, tol=1e-7, max_steps=15)
                      .run(hops, [100], chunks=2)[0])
     np.testing.assert_array_equal(one, two)
+
+
+def test_hopbatch_warm_start_matches_cold_within_tol():
+    """Warm-started chunked sweeps converge to the same fixed point as the
+    cold one-dispatch sweep (agreement to solver tolerance, not bitwise),
+    and non-contraction engines refuse the flag."""
+    from raphtory_tpu.engine.hopbatch import HopBatchedCC
+
+    rng = np.random.default_rng(21)
+    log = random_log(rng, n_events=900, n_ids=60, t_span=120)
+    hops = [30, 60, 90, 100, 110, 119]
+    windows = [1000, 40]
+    cold = np.asarray(HopBatchedPageRank(log, tol=1e-9, max_steps=100)
+                      .run(hops, windows)[0])
+    warm = np.asarray(HopBatchedPageRank(log, tol=1e-9, max_steps=100)
+                      .run(hops, windows, chunks=3, warm_start=True)[0])
+    np.testing.assert_allclose(cold, warm, atol=1e-6, rtol=0)
+
+    with pytest.raises(ValueError, match="warm-start"):
+        HopBatchedCC(log).run(hops, windows, chunks=3, warm_start=True)
