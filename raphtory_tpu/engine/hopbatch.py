@@ -266,7 +266,7 @@ class _HopBatched:
         raise NotImplementedError
 
     def run(self, hop_times, windows, chunks: int = 1,
-            warm_start: bool = False):
+            warm_start: bool = False, hop_callback=None):
         """``chunks=k`` pipelines the sweep; ``warm_start=True``
         additionally initialises each chunk's columns from the previous
         chunk's LAST-hop ranks (same fixed point, reached in far fewer
@@ -287,7 +287,7 @@ class _HopBatched:
                     "%d hops do not split into %d equal chunks — running "
                     "one cold dispatch (warm_start has no effect)",
                     len(hop_times), chunks)
-            hop_times, cols = self._fold_columns(hop_times)
+            hop_times, cols = self._fold_columns(hop_times, hop_callback)
             return self._dispatch_cols(cols, hop_times, windows)
         per = len(hop_times) // chunks
         W = len(normalize_windows(windows))
@@ -295,7 +295,7 @@ class _HopBatched:
         steps = jnp.int32(0)
         for c in range(chunks):
             group = hop_times[c * per: (c + 1) * per]
-            group, cols = self._fold_columns(group)
+            group, cols = self._fold_columns(group, hop_callback)
             r_init = None
             if warm_start and outs:
                 # previous chunk's last hop: rows [-W:] are its W windowed
@@ -309,7 +309,7 @@ class _HopBatched:
             steps = jnp.maximum(steps, st)
         return jnp.concatenate(outs, axis=0), steps
 
-    def _fold_columns(self, hop_times):
+    def _fold_columns(self, hop_times, hop_callback=None):
         t = self.tables
         hop_times = [int(x) for x in hop_times]
         if sorted(hop_times) != hop_times:
@@ -338,6 +338,9 @@ class _HopBatched:
 
         for j, T in enumerate(hop_times):
             self.sw._advance(T)
+            if hop_callback is not None:
+                # post-advance fold state, e.g. for per-hop reducer shells
+                hop_callback(T, self.sw)
             if j == 0:
                 pos = t.eng_pos(self.sw.e_enc)
                 e_lat[0, pos] = t.cast_times(self.sw.e_lat)

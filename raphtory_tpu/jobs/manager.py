@@ -40,6 +40,12 @@ class RangeQuery:
     window: int | None = None
     windows: tuple | None = None
 
+    def __post_init__(self):
+        if int(self.jump) <= 0:
+            # jump=0 would spin every sweep loop forever (REST bodies pass
+            # raw ints straight through) — refuse at construction
+            raise ValueError(f"jump must be positive, got {self.jump}")
+
 
 @dataclass(frozen=True)
 class LiveQuery:
@@ -106,6 +112,7 @@ class Job:
                 # one device the device-resident sweep (engine/device_sweep)
                 # — fold state stays on the chip, hops ship O(delta) bytes.
                 if not (self._try_range_mesh(q)
+                        or self._try_range_hopbatch(q)
                         or self._try_range_device(q)):
                     sweep = None
                     if self.graph.safe_time() >= q.end:
@@ -200,6 +207,68 @@ class Job:
                              windows=windows, block=False)
 
         self._range_amortised(q, sweep.advance, run, sweep.reduce_view)
+        return True
+
+    def _try_range_hopbatch(self, q: RangeQuery) -> bool:
+        """Whole-range columnar dispatch for PageRank Range queries: every
+        (hop, window) view of the range is a COLUMN of one compiled program
+        (``engine/hopbatch``), pipelined in equal hop chunks with
+        warm-started columns — against the reference's full per-hop actor
+        handshake (``RangeAnalysisTask.scala:18-35``). PageRank only: its
+        finalize is the raw rank vector the columns compute, and the power
+        iteration warm-starts safely; CC/BFS take the device-resident path.
+        ``viewTime`` on emitted rows is the AMORTISED share of the one
+        dispatch (plus that row's own reduce), not a per-hop wall time."""
+        import numpy as np
+
+        from ..algorithms import PageRank as _PR
+        from ..engine.hopbatch import HopBatchedPageRank
+
+        if self.mesh is not None or self.graph.safe_time() < q.end:
+            return False
+        if type(self.program) is not _PR:
+            return False
+        p = self.program
+        try:
+            hb = HopBatchedPageRank(self.graph.log, damping=p.damping,
+                                    tol=p.tol, max_steps=p.max_steps)
+        except ValueError:
+            return False  # >2^31 distinct vertices: packed keys exhausted
+        hops = list(range(int(q.start), int(q.end) + 1, int(q.jump)))
+        if not hops or self._kill.is_set():
+            return bool(hops)
+        windows = list(q.windows) if q.windows is not None else [q.window]
+        W = len(windows)
+        # columnar state is O(hops * (m_pad + n_pad)) on host and
+        # O(m_pad * hops * W) masks on device — long ranges stay on the
+        # O(1)-memory-per-hop device-resident path instead
+        if (len(hops) * (hb.tables.m_pad + hb.tables.n_pad) > 1 << 28
+                or len(hops) * W > 1024):
+            return False
+
+        shells = []
+
+        def grab_shell(T, sw):
+            shells.append(_shell_from_fold(hb.tables, sw, int(T)))
+
+        chunks = next((k for k in (4, 3, 2)
+                       if len(hops) >= 2 * k and len(hops) % k == 0), 1)
+        t0 = _time.perf_counter()
+        ranks, steps = hb.run(hops, windows, chunks=chunks,
+                              warm_start=chunks > 1,
+                              hop_callback=grab_shell)
+        ranks = np.asarray(ranks)   # blocks on the device result
+        steps = int(steps)
+        elapsed = _time.perf_counter() - t0
+        per_row = elapsed / (len(hops) * W)
+        METRICS.snapshot_build_seconds.observe(0.0)
+        METRICS.supersteps.inc(max(steps, 0))
+        for j, T in enumerate(hops):
+            if self._kill.is_set():
+                return True
+            for i, w in enumerate(windows):
+                self._emit(T, w, ranks[j * W + i], shells[j], steps,
+                           _time.perf_counter() - per_row)
         return True
 
     def _try_range_device(self, q: RangeQuery) -> bool:
@@ -320,6 +389,25 @@ class Job:
         self.results.append(row)
 
 
+def _shell_from_fold(tables, sw, T):
+    """Reducer-facing vertex shell from a SweepBuilder's fold state at T
+    (vertex-side fields only — gated by ``reduce_shell_safe``)."""
+    import numpy as np
+
+    from ..core.snapshot import INT64_MIN
+    from ..parallel.sweep import _Shell
+
+    n, n_pad = tables.n, tables.n_pad
+    vm = np.zeros(n_pad, bool)
+    vm[:n] = sw.v_alive
+    vl = np.full(n_pad, INT64_MIN, np.int64)
+    vl[:n] = sw.v_lat
+    vf = np.full(n_pad, INT64_MIN, np.int64)
+    vf[:n] = sw.v_first
+    return _Shell(time=int(T), n_pad=n_pad, vids=tables.vids, v_mask=vm,
+                  v_latest_time=vl, v_first_time=vf)
+
+
 class _DeviceShell:
     """Reducer-facing view shells over a DeviceSweep's HOST fold state
     (the device buffers' numpy twin lives in the SweepBuilder)."""
@@ -328,23 +416,8 @@ class _DeviceShell:
         self.sweep = sweep
 
     def freeze(self):
-        import numpy as np
-
-        from ..core.snapshot import INT64_MIN
-        from ..parallel.sweep import _Shell
-
         ds = self.sweep
-        n, n_pad = ds.n, ds.n_pad
-        vids = np.full(n_pad, -1, np.int64)
-        vids[:n] = ds.uv
-        vm = np.zeros(n_pad, bool)
-        vm[:n] = ds.sw.v_alive
-        vl = np.full(n_pad, INT64_MIN, np.int64)
-        vl[:n] = ds.sw.v_lat
-        vf = np.full(n_pad, INT64_MIN, np.int64)
-        vf[:n] = ds.sw.v_first
-        return _Shell(time=int(ds.t_now), n_pad=n_pad, vids=vids, v_mask=vm,
-                      v_latest_time=vl, v_first_time=vf)
+        return _shell_from_fold(ds.tables, ds.sw, ds.t_now)
 
 
 class AnalysisManager:

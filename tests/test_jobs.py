@@ -304,3 +304,51 @@ def test_module_entrypoint_serves_rest(tmp_path):
             p.kill()
             out, _ = p.communicate()
     assert p.returncode == 0, out[-2000:]
+
+
+def test_range_pagerank_rides_hopbatch_and_matches_view_jobs(monkeypatch):
+    """PageRank Range jobs take the whole-range columnar route, and its
+    rows agree with independently-computed per-view jobs."""
+    from raphtory_tpu.engine import hopbatch
+
+    calls = []
+    orig = hopbatch.HopBatchedPageRank.run
+
+    def spy(self, *a, **kw):
+        calls.append(kw.get("chunks", a[2] if len(a) > 2 else 1))
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(hopbatch.HopBatchedPageRank, "run", spy)
+    g = _graph()
+    mgr = AnalysisManager(g)
+    pr = registry.resolve("PageRank", {"max_steps": 30, "tol": 1e-9})
+    q = RangeQuery(start=20, end=90, jump=10, windows=(100, 25))
+    job = mgr.submit(pr, q)
+    assert job.wait(60)
+    assert job.status == "done", job.error
+    assert calls, "hopbatch route was not taken"
+    assert len(job.results) == 8 * 2
+
+    for t in (20, 50, 90):
+        vjob = mgr.submit(registry.resolve(
+            "PageRank", {"max_steps": 30, "tol": 1e-9}),
+            ViewQuery(t, windows=(100, 25)))
+        assert vjob.wait(30)
+        for vrow in vjob.results:
+            rrow = next(r for r in job.results
+                        if r["time"] == t
+                        and r["windowsize"] == vrow["windowsize"])
+            assert rrow["result"]["sum"] == pytest.approx(
+                vrow["result"]["sum"], abs=1e-4)
+            rtop = dict(rrow["result"]["top10"])
+            vtop = dict(vrow["result"]["top10"])
+            assert set(rtop) == set(vtop)
+            for k in rtop:
+                assert rtop[k] == pytest.approx(vtop[k], abs=1e-5)
+
+
+def test_range_query_rejects_nonpositive_jump():
+    with pytest.raises(ValueError, match="jump"):
+        RangeQuery(start=0, end=10, jump=0)
+    with pytest.raises(ValueError, match="jump"):
+        RangeQuery(start=0, end=10, jump=-5)
