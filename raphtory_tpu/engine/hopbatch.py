@@ -26,6 +26,7 @@ reference runs its full actor handshake once per hop
 from __future__ import annotations
 
 import functools
+import time as _time
 
 import jax
 import jax.numpy as jnp
@@ -254,6 +255,9 @@ class _HopBatched:
     def __init__(self, log: EventLog):
         self.sw = SweepBuilder(log)
         self.tables = GlobalTables(self.sw)
+        #: host seconds spent folding + writing columns in the LAST run()
+        #: (callers report it as snapshot-build time)
+        self.fold_seconds = 0.0
         # static edge tables upload once, like DeviceSweep
         self._e_src = jnp.asarray(self.tables.e_src)
         self._e_dst = jnp.asarray(self.tables.e_dst)
@@ -272,6 +276,7 @@ class _HopBatched:
         chunk's LAST-hop ranks (same fixed point, reached in far fewer
         steps when consecutive hops differ little). Warm-started results
         agree with cold ones to the solver tolerance, not bitwise."""
+        self.fold_seconds = 0.0
         if warm_start and not self.supports_warm_start:
             raise ValueError(
                 f"{type(self).__name__} cannot warm-start: its superstep "
@@ -310,6 +315,7 @@ class _HopBatched:
         return jnp.concatenate(outs, axis=0), steps
 
     def _fold_columns(self, hop_times, hop_callback=None):
+        f0 = _time.perf_counter()
         t = self.tables
         hop_times = [int(x) for x in hop_times]
         if sorted(hop_times) != hop_times:
@@ -361,6 +367,7 @@ class _HopBatched:
             if len(d["v_idx"]):
                 v_lat[j, d["v_idx"]] = t.cast_times(d["v_lat"])
                 v_alive[j, d["v_idx"]] = d["v_alive"]
+        self.fold_seconds += _time.perf_counter() - f0
         return hop_times, (e_lat, e_alive, v_lat, v_alive)
 
 

@@ -214,36 +214,45 @@ class Job:
         (hop, window) view of the range is a COLUMN of one compiled program
         (``engine/hopbatch``), pipelined in equal hop chunks with
         warm-started columns — against the reference's full per-hop actor
-        handshake (``RangeAnalysisTask.scala:18-35``). PageRank only: its
-        finalize is the raw rank vector the columns compute, and the power
-        iteration warm-starts safely; CC/BFS take the device-resident path.
-        ``viewTime`` on emitted rows is the AMORTISED share of the one
-        dispatch (plus that row's own reduce), not a per-hop wall time."""
+        handshake (``RangeAnalysisTask.scala:18-35``). PageRank (finalize
+        is the raw rank vector; power iteration warm-starts safely) and
+        ConnectedComponents (labels are global padded indices in both
+        engines; no warm start — min-propagation is not a contraction on a
+        changing edge set). ``viewTime`` on emitted rows is the AMORTISED
+        share of the one dispatch (plus that row's own reduce), not a
+        per-hop wall time."""
         import numpy as np
 
+        from ..algorithms import ConnectedComponents as _CC
         from ..algorithms import PageRank as _PR
-        from ..engine.hopbatch import HopBatchedPageRank
+        from ..engine.hopbatch import HopBatchedCC, HopBatchedPageRank
 
         if self.mesh is not None or self.graph.safe_time() < q.end:
             return False
-        if type(self.program) is not _PR:
+        hops = list(range(int(q.start), int(q.end) + 1, int(q.jump)))
+        windows = list(q.windows) if q.windows is not None else [q.window]
+        W = len(windows)
+        if not hops or len(hops) * W > 1024:
+            # the cheap half of the size guard — before paying for tables
             return False
         p = self.program
         try:
-            hb = HopBatchedPageRank(self.graph.log, damping=p.damping,
-                                    tol=p.tol, max_steps=p.max_steps)
+            if type(p) is _PR:
+                hb = HopBatchedPageRank(self.graph.log, damping=p.damping,
+                                        tol=p.tol, max_steps=p.max_steps)
+            elif type(p) is _CC:
+                hb = HopBatchedCC(self.graph.log, max_steps=p.max_steps)
+            else:
+                return False
         except ValueError:
             return False  # >2^31 distinct vertices: packed keys exhausted
-        hops = list(range(int(q.start), int(q.end) + 1, int(q.jump)))
-        if not hops or self._kill.is_set():
-            return bool(hops)
-        windows = list(q.windows) if q.windows is not None else [q.window]
-        W = len(windows)
-        # columnar state is O(hops * (m_pad + n_pad)) on host and
-        # O(m_pad * hops * W) masks on device — long ranges stay on the
-        # O(1)-memory-per-hop device-resident path instead
-        if (len(hops) * (hb.tables.m_pad + hb.tables.n_pad) > 1 << 28
-                or len(hops) * W > 1024):
+        if self._kill.is_set():
+            return True
+        # columnar state is O(hops * (m_pad + n_pad)) on host — big graphs
+        # with long ranges stay on the O(1)-memory-per-hop device-resident
+        # path instead (which rebuilds its own tables; a rejected range
+        # pays the table build twice, acceptably rare at this guard size)
+        if len(hops) * (hb.tables.m_pad + hb.tables.n_pad) > 1 << 28:
             return False
 
         shells = []
@@ -255,13 +264,16 @@ class Job:
                        if len(hops) >= 2 * k and len(hops) % k == 0), 1)
         t0 = _time.perf_counter()
         ranks, steps = hb.run(hops, windows, chunks=chunks,
-                              warm_start=chunks > 1,
+                              warm_start=chunks > 1
+                              and hb.supports_warm_start,
                               hop_callback=grab_shell)
         ranks = np.asarray(ranks)   # blocks on the device result
         steps = int(steps)
         elapsed = _time.perf_counter() - t0
         per_row = elapsed / (len(hops) * W)
-        METRICS.snapshot_build_seconds.observe(0.0)
+        for _ in hops:   # per-hop share of the measured incremental fold
+            METRICS.snapshot_build_seconds.observe(
+                hb.fold_seconds / len(hops))
         METRICS.supersteps.inc(max(steps, 0))
         for j, T in enumerate(hops):
             if self._kill.is_set():

@@ -352,3 +352,34 @@ def test_range_query_rejects_nonpositive_jump():
         RangeQuery(start=0, end=10, jump=0)
     with pytest.raises(ValueError, match="jump"):
         RangeQuery(start=0, end=10, jump=-5)
+
+
+def test_range_cc_rides_hopbatch_and_matches_view_jobs(monkeypatch):
+    from raphtory_tpu.engine import hopbatch
+
+    calls = []
+    orig = hopbatch.HopBatchedCC.run
+
+    def spy(self, *a, **kw):
+        calls.append(1)
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(hopbatch.HopBatchedCC, "run", spy)
+    g = _graph()
+    mgr = AnalysisManager(g)
+    q = RangeQuery(start=20, end=90, jump=10, windows=(100, 25))
+    job = mgr.submit(registry.resolve("ConnectedComponents",
+                                      {"max_steps": 60}), q)
+    assert job.wait(60)
+    assert job.status == "done", job.error
+    assert calls, "hopbatch CC route was not taken"
+    for t in (20, 60, 90):
+        vjob = mgr.submit(registry.resolve("ConnectedComponents",
+                                           {"max_steps": 60}),
+                          ViewQuery(t, windows=(100, 25)))
+        assert vjob.wait(30)
+        for vrow in vjob.results:
+            rrow = next(r for r in job.results
+                        if r["time"] == t
+                        and r["windowsize"] == vrow["windowsize"])
+            assert rrow["result"] == vrow["result"], (t, vrow["windowsize"])
