@@ -225,7 +225,9 @@ class Job:
 
         from ..algorithms import ConnectedComponents as _CC
         from ..algorithms import PageRank as _PR
-        from ..engine.hopbatch import HopBatchedCC, HopBatchedPageRank
+        from ..algorithms.traversal import SSSP as _SSSP
+        from ..engine.hopbatch import (HopBatchedBFS, HopBatchedCC,
+                                       HopBatchedPageRank)
 
         if self.mesh is not None or self.graph.safe_time() < q.end:
             return False
@@ -242,6 +244,13 @@ class Job:
                                         tol=p.tol, max_steps=p.max_steps)
             elif type(p) is _CC:
                 hb = HopBatchedCC(self.graph.log, max_steps=p.max_steps)
+            elif type(p) is _SSSP and not p.weight_prop:
+                # unit-weight traversal (BFS) — the columnar distances are
+                # exactly SSSP's finalize output; weighted SSSP needs edge
+                # property joins and stays on the per-view path
+                hb = HopBatchedBFS(self.graph.log, p.seeds,
+                                   directed=p.directed,
+                                   max_steps=p.max_steps)
             else:
                 return False
         except ValueError:
@@ -410,13 +419,17 @@ def _shell_from_fold(tables, sw, T):
     from ..parallel.sweep import _Shell
 
     n, n_pad = tables.n, tables.n_pad
+    vids = tables.vids
+    if vids is None:   # DeviceSweep frees the host copy after upload
+        vids = np.full(n_pad, -1, np.int64)
+        vids[:n] = tables.uv
     vm = np.zeros(n_pad, bool)
     vm[:n] = sw.v_alive
     vl = np.full(n_pad, INT64_MIN, np.int64)
     vl[:n] = sw.v_lat
     vf = np.full(n_pad, INT64_MIN, np.int64)
     vf[:n] = sw.v_first
-    return _Shell(time=int(T), n_pad=n_pad, vids=tables.vids, v_mask=vm,
+    return _Shell(time=int(T), n_pad=n_pad, vids=vids, v_mask=vm,
                   v_latest_time=vl, v_first_time=vf)
 
 

@@ -306,80 +306,100 @@ def test_module_entrypoint_serves_rest(tmp_path):
     assert p.returncode == 0, out[-2000:]
 
 
-def test_range_pagerank_rides_hopbatch_and_matches_view_jobs(monkeypatch):
-    """PageRank Range jobs take the whole-range columnar route, and its
-    rows agree with independently-computed per-view jobs."""
-    from raphtory_tpu.engine import hopbatch
-
-    calls = []
-    orig = hopbatch.HopBatchedPageRank.run
-
-    def spy(self, *a, **kw):
-        calls.append(kw.get("chunks", a[2] if len(a) > 2 else 1))
-        return orig(self, *a, **kw)
-
-    monkeypatch.setattr(hopbatch.HopBatchedPageRank, "run", spy)
-    g = _graph()
-    mgr = AnalysisManager(g)
-    pr = registry.resolve("PageRank", {"max_steps": 30, "tol": 1e-9})
-    q = RangeQuery(start=20, end=90, jump=10, windows=(100, 25))
-    job = mgr.submit(pr, q)
-    assert job.wait(60)
-    assert job.status == "done", job.error
-    assert calls, "hopbatch route was not taken"
-    assert len(job.results) == 8 * 2
-
-    for t in (20, 50, 90):
-        vjob = mgr.submit(registry.resolve(
-            "PageRank", {"max_steps": 30, "tol": 1e-9}),
-            ViewQuery(t, windows=(100, 25)))
-        assert vjob.wait(30)
-        for vrow in vjob.results:
-            rrow = next(r for r in job.results
-                        if r["time"] == t
-                        and r["windowsize"] == vrow["windowsize"])
-            assert rrow["result"]["sum"] == pytest.approx(
-                vrow["result"]["sum"], abs=1e-4)
-            rtop = dict(rrow["result"]["top10"])
-            vtop = dict(vrow["result"]["top10"])
-            assert set(rtop) == set(vtop)
-            for k in rtop:
-                assert rtop[k] == pytest.approx(vtop[k], abs=1e-5)
-
-
 def test_range_query_rejects_nonpositive_jump():
     with pytest.raises(ValueError, match="jump"):
         RangeQuery(start=0, end=10, jump=0)
     with pytest.raises(ValueError, match="jump"):
         RangeQuery(start=0, end=10, jump=-5)
 
-
-def test_range_cc_rides_hopbatch_and_matches_view_jobs(monkeypatch):
-    from raphtory_tpu.engine import hopbatch
-
-    calls = []
-    orig = hopbatch.HopBatchedCC.run
-
-    def spy(self, *a, **kw):
-        calls.append(1)
-        return orig(self, *a, **kw)
-
-    monkeypatch.setattr(hopbatch.HopBatchedCC, "run", spy)
-    g = _graph()
-    mgr = AnalysisManager(g)
-    q = RangeQuery(start=20, end=90, jump=10, windows=(100, 25))
-    job = mgr.submit(registry.resolve("ConnectedComponents",
-                                      {"max_steps": 60}), q)
-    assert job.wait(60)
-    assert job.status == "done", job.error
-    assert calls, "hopbatch CC route was not taken"
+def _assert_range_rows_match_view_jobs(job, make_program, mgr, approx=None):
+    """Every Range row must agree with an independently-computed per-view
+    job at the same (time, windowsize)."""
     for t in (20, 60, 90):
-        vjob = mgr.submit(registry.resolve("ConnectedComponents",
-                                           {"max_steps": 60}),
-                          ViewQuery(t, windows=(100, 25)))
+        vjob = mgr.submit(make_program(), ViewQuery(t, windows=(100, 25)))
         assert vjob.wait(30)
         for vrow in vjob.results:
             rrow = next(r for r in job.results
                         if r["time"] == t
                         and r["windowsize"] == vrow["windowsize"])
-            assert rrow["result"] == vrow["result"], (t, vrow["windowsize"])
+            if approx is None:
+                assert rrow["result"] == vrow["result"], \
+                    (t, vrow["windowsize"])
+            else:
+                approx(rrow["result"], vrow["result"])
+
+
+_HOPBATCH_CASES = [
+    ("HopBatchedPageRank",
+     lambda: registry.resolve("PageRank", {"max_steps": 200, "tol": 1e-9})),
+    ("HopBatchedCC",
+     lambda: registry.resolve("ConnectedComponents", {"max_steps": 60})),
+    ("HopBatchedBFS",
+     lambda: registry.resolve(
+         "BFS", {"seeds": (0, 1), "directed": False, "max_steps": 50})),
+]
+
+
+@pytest.mark.parametrize("hb_name,make_program", _HOPBATCH_CASES,
+                         ids=[c[0] for c in _HOPBATCH_CASES])
+def test_range_jobs_ride_hopbatch_and_match_view_jobs(
+        monkeypatch, hb_name, make_program):
+    from raphtory_tpu.engine import hopbatch
+
+    calls = []
+    orig = getattr(hopbatch, hb_name).run
+
+    def spy(self, *a, **kw):
+        calls.append(1)
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(getattr(hopbatch, hb_name), "run", spy)
+    g = _graph()
+    mgr = AnalysisManager(g)
+    q = RangeQuery(start=20, end=90, jump=10, windows=(100, 25))
+    job = mgr.submit(make_program(), q)
+    assert job.wait(60)
+    assert job.status == "done", job.error
+    assert calls, f"{hb_name} route was not taken"
+
+    def approx_pr(a, b):
+        assert a["sum"] == pytest.approx(b["sum"], abs=1e-4)
+        ra, rb = dict(a["top10"]), dict(b["top10"])
+        assert set(ra) == set(rb)
+        for k in ra:
+            assert ra[k] == pytest.approx(rb[k], abs=1e-5)
+
+    _assert_range_rows_match_view_jobs(
+        job, make_program, mgr,
+        approx=approx_pr if hb_name == "HopBatchedPageRank" else None)
+
+
+def test_range_bfs_on_device_sweep_matches_view_jobs(monkeypatch):
+    """reduce_shell_safe on SSSP also unlocks the device-resident range
+    path (hopbatch declined here) — pin its semantics too."""
+    from raphtory_tpu.jobs import manager as _mgr_mod
+
+    monkeypatch.setattr(_mgr_mod.Job, "_try_range_hopbatch",
+                        lambda self, q: False)
+    taken = []
+    orig = _mgr_mod.Job._try_range_device
+
+    def spy(self, q):
+        r = orig(self, q)
+        taken.append(r)
+        return r
+
+    monkeypatch.setattr(_mgr_mod.Job, "_try_range_device", spy)
+
+    def bfs():
+        return registry.resolve(
+            "BFS", {"seeds": (0, 1), "directed": False, "max_steps": 50})
+
+    g = _graph()
+    mgr = AnalysisManager(g)
+    q = RangeQuery(start=20, end=90, jump=10, windows=(100, 25))
+    job = mgr.submit(bfs(), q)
+    assert job.wait(120)
+    assert job.status == "done", job.error
+    assert taken == [True], "device-resident route was not taken"
+    _assert_range_rows_match_view_jobs(job, bfs, mgr)
