@@ -751,38 +751,63 @@ CONFIGS = {
 }
 
 
+def _run_config_subproc(name: str, timeout: float = 900.0,
+                        device: str | None = None,
+                        env: dict | None = None) -> dict:
+    """Run one config in a subprocess with a hard timeout and return its
+    tail JSON row. The scale configs compile large programs through the
+    remote compile helper, which has been observed to HANG (not raise) on
+    some shapes — in-process that would eat the whole suite including the
+    headline row the driver parses; a killed subprocess just becomes an
+    error row."""
+    import os
+    import subprocess
+
+    try:
+        cmd = [sys.executable, __file__, "--config", name,
+               "--no-crosscheck"]
+        if device:   # a pinned parent pins its subprocesses too
+            cmd += ["--device", device]
+        out = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, **(env or {})})
+    except subprocess.TimeoutExpired:
+        return {"config": name, "metric": name, "value": 0.0,
+                "unit": "error", "vs_baseline": 0.0,
+                "error": f"config subprocess timed out (> {timeout}s)",
+                "detail": {}}
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(row, dict):
+            return row
+    return {"config": name, "metric": name, "value": 0.0, "unit": "error",
+            "vs_baseline": 0.0,
+            "error": "no JSON from config subprocess: "
+                     f"{(out.stderr or '').strip()[-300:]}",
+            "detail": {}}
+
+
 def _cpu_crosscheck(config: str = "headline", timeout: float = 420.0,
                     env: dict | None = None) -> dict:
     """Re-run a config in a subprocess pinned to the CPU backend — proof
     alongside the accelerator number that the chip path is not losing to
     the host fallback (round-3 verdict's central ask). ``env`` overrides
     (e.g. RTPU_SCALE_*) force the SAME problem size as the device run."""
-    import os
-    import subprocess
-
-    try:
-        out = subprocess.run(
-            [sys.executable, __file__, "--config", config,
-             "--device", "cpu", "--no-crosscheck"],
-            capture_output=True, text=True, timeout=timeout,
-            env={**os.environ, **(env or {})})
-        for line in reversed(out.stdout.strip().splitlines()):
-            try:
-                row = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if row.get("device") != "cpu":
-                # a mislabelled crosscheck would fake the TPU-vs-CPU proof
-                return {"error": "crosscheck subprocess ran on "
-                                 f"{row.get('device')!r}, not cpu"}
-            return {"value": row.get("value"), "unit": row.get("unit"),
-                    "device": row.get("device"),
-                    "sweep_seconds": row.get("detail", {}).get("sweep_seconds"),
-                    "engine": row.get("detail", {}).get("engine")}
-        return {"error": f"no JSON in crosscheck output: "
-                         f"{(out.stderr or '').strip()[-300:]}"}
-    except subprocess.TimeoutExpired:
-        return {"error": f"cpu crosscheck timed out (> {timeout}s)"}
+    row = _run_config_subproc(config, timeout=timeout, device="cpu",
+                              env=env)
+    if "error" in row:
+        return {"error": row["error"]}
+    if row.get("device") != "cpu":
+        # a mislabelled crosscheck would fake the TPU-vs-CPU proof
+        return {"error": "crosscheck subprocess ran on "
+                         f"{row.get('device')!r}, not cpu"}
+    return {"value": row.get("value"), "unit": row.get("unit"),
+            "device": row.get("device"),
+            "sweep_seconds": row.get("detail", {}).get("sweep_seconds"),
+            "engine": row.get("detail", {}).get("engine")}
 
 
 def main():
@@ -841,16 +866,26 @@ def main():
     import os
 
     os.environ["RTPU_BENCH_DEVICE"] = device
+    # the scale configs compile the largest programs — isolate them so a
+    # hung remote compile can't take the headline row down with it (only
+    # when running the multi-config suite; a single --config run IS the
+    # subprocess)
+    subproc = {"scale_pagerank", "scale_features"} if len(names) > 1 else set()
     for name in names:
         try:
-            row = CONFIGS[name]()
+            if name in subproc:
+                row = _run_config_subproc(name, device=args.device)
+            else:
+                row = CONFIGS[name]()
             row["config"] = name
-            row["device"] = device
-            row["probe"] = probe
+            # subprocess rows keep their own device/probe provenance (they
+            # may have fallen back to CPU independently of the parent)
+            row.setdefault("device", device)
+            row.setdefault("probe", probe)
             if (name == "headline" and device != "cpu"
                     and not args.no_crosscheck):
                 row["detail"]["cpu_crosscheck"] = _cpu_crosscheck()
-            if (name == "scale_pagerank" and device != "cpu"
+            if (name == "scale_pagerank" and row.get("device") != "cpu"
                     and not args.no_crosscheck and "error" not in row):
                 # SAME problem size on the CPU backend (the fallback shrink
                 # env must not apply, or the comparison is meaningless)
@@ -859,7 +894,7 @@ def main():
                     env={"RTPU_SCALE_V": str(row["detail"]["n_vertices"]),
                          "RTPU_SCALE_E": str(row["detail"]["n_edge_events"]),
                          "RTPU_CROSSCHECK": "1"})
-            if (name == "scale_features" and device != "cpu"
+            if (name == "scale_features" and row.get("device") != "cpu"
                     and not args.no_crosscheck and "error" not in row):
                 row["detail"]["cpu_same_size_crosscheck"] = _cpu_crosscheck(
                     "scale_features", timeout=1200.0,

@@ -421,8 +421,11 @@ def _shell_from_fold(tables, sw, T):
     n, n_pad = tables.n, tables.n_pad
     vids = tables.vids
     if vids is None:   # DeviceSweep frees the host copy after upload
-        vids = np.full(n_pad, -1, np.int64)
-        vids[:n] = tables.uv
+        vids = getattr(tables, "_shell_vids", None)
+        if vids is None:   # rebuild once per sweep, not once per hop
+            vids = np.full(n_pad, -1, np.int64)
+            vids[:n] = tables.uv
+            tables._shell_vids = vids
     vm = np.zeros(n_pad, bool)
     vm[:n] = sw.v_alive
     vl = np.full(n_pad, INT64_MIN, np.int64)

@@ -361,6 +361,7 @@ def test_range_jobs_ride_hopbatch_and_match_view_jobs(
     assert job.wait(60)
     assert job.status == "done", job.error
     assert calls, f"{hb_name} route was not taken"
+    assert len(job.results) == 8 * 2   # every (hop, window) row emitted
 
     def approx_pr(a, b):
         assert a["sum"] == pytest.approx(b["sum"], abs=1e-4)
