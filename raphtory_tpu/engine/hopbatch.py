@@ -169,23 +169,26 @@ def _compiled_cc(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
 
 @functools.lru_cache(maxsize=64)
 def _compiled_bfs(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
-                  directed: bool, tdt: str):
-    """Columnar BFS (min-plus hop counting from seed vertices) — semantics
-    of ``algorithms/traversal.SSSP`` with unit weights."""
+                  directed: bool, tdt: str, weighted: bool = False):
+    """Columnar min-plus traversal from seed vertices — semantics of
+    ``algorithms/traversal.SSSP``: unit weights (BFS hop counting) by
+    default; ``weighted=True`` takes hop-major per-edge weight columns
+    (``[H, m_pad]`` f32, missing values pre-folded to 1.0)."""
     tdt = jnp.dtype(tdt)
     INF = jnp.float32(jnp.inf)
 
     def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
-            hop_of_col, T_col, w_col, seed_mask):
+            hop_of_col, T_col, w_col, seed_mask, *rest):
         me, mv = _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
                                hop_of_col, T_col, w_col)
+        ew = rest[0][hop_of_col].T if weighted else 1.0   # [m_pad, C]
         d0 = jnp.where(mv & seed_mask[:, None], 0.0, INF)
 
         def body(carry):
             step, dist, halted = carry
 
             def pull(idx_from, idx_to, sorted_):
-                payload = jnp.where(me, dist[idx_from, :] + 1.0, INF)
+                payload = jnp.where(me, dist[idx_from, :] + ew, INF)
                 return jax.ops.segment_min(
                     payload, idx_to, num_segments=n_pad,
                     indices_are_sorted=sorted_)
@@ -211,9 +214,12 @@ def _compiled_bfs(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
 
 def run_bfs_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
                     windows, seed_vids, *, directed: bool = False,
-                    max_steps: int = 100, e_src_dev=None, e_dst_dev=None):
-    """Columnar BFS over prebuilt fold columns; ``seed_vids`` are external
-    vertex ids looked up in the global dense space (absent ids ignored)."""
+                    max_steps: int = 100, e_src_dev=None, e_dst_dev=None,
+                    weight_cols=None):
+    """Columnar min-plus traversal over prebuilt fold columns;
+    ``seed_vids`` are external vertex ids looked up in the global dense
+    space (absent ids ignored). ``weight_cols`` ([H, m_pad] f32, missing
+    folded to 1.0) turns hop counting into weighted SSSP."""
     H, C, hop_of_col, T_col, w_col = _column_layout(hop_times, windows)
     seed_mask = np.zeros(tables.n_pad, bool)
     seeds = np.asarray(sorted({int(v) for v in seed_vids}), np.int64)
@@ -223,11 +229,14 @@ def run_bfs_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
         ok = tables.uv[pos] == seeds
         seed_mask[pos[ok]] = True
     runner = _compiled_bfs(tables.n_pad, tables.m_pad, H, C, int(max_steps),
-                           bool(directed), np.dtype(tables.tdtype).name)
+                           bool(directed), np.dtype(tables.tdtype).name,
+                           weight_cols is not None)
+    extra = (seed_mask,) if weight_cols is None \
+        else (seed_mask, weight_cols)
     return _dispatch_columns(runner, tables,
                              (e_lat, e_alive, v_lat, v_alive),
                              hop_of_col, T_col, w_col, e_src_dev, e_dst_dev,
-                             seed_mask)
+                             *extra)
 
 
 def run_cc_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
@@ -410,6 +419,87 @@ class HopBatchedBFS(_HopBatched):
             self.tables, *cols, hop_times, windows, self.seeds,
             directed=self.directed, max_steps=self.max_steps,
             e_src_dev=self._e_src, e_dst_dev=self._e_dst)
+
+
+class HopBatchedSSSP(HopBatchedBFS):
+    """Weighted min-plus traversal over a full sweep in one call.
+
+    Per-pair weights are the LATEST numeric value of ``weight_prop`` at
+    each hop (``_materialise_prop`` semantics incl. the (time, event-row)
+    tie-break, ``snapshot.py``), folded incrementally into hop-major
+    ``[H, m_pad]`` columns next to the alive/lat columns; pairs that never
+    set the key weigh 1.0 (``SSSP.message``'s NaN rule). Immutable keys
+    (earliest-wins) are refused — the ascending fold is last-wins."""
+
+    def __init__(self, log: EventLog, seeds, weight_prop: str,
+                 directed: bool = False, max_steps: int = 100):
+        super().__init__(log, seeds, directed=directed, max_steps=max_steps)
+        log = self.sw.log
+        if weight_prop in log.props._key_ids \
+                and log.props.is_immutable(log.props._key_ids[weight_prop]):
+            raise ValueError(
+                f"{weight_prop!r} is an immutable (earliest-wins) key — "
+                "the incremental weight fold is last-wins; use the "
+                "per-view path")
+        self.weight_prop = weight_prop
+        t = self.tables
+        # all numeric rows of the key on EDGE_ADD events, sorted by
+        # (time, event-row) — the same order _materialise_prop's lexsort
+        # picks "latest" from — plus a running per-pair state row
+        self._w_state = np.ones(t.m_pad, np.float32)
+        if weight_prop in log.props._key_ids:
+            kid = log.props._key_ids[weight_prop]
+            pe = log.props.column("event")
+            sel = ((log.props.column("key") == kid)
+                   & (log.props.column("tag") == log.props.NUM_TAG))
+            ev = pe[sel]
+            kinds = log.column("kind")[ev]
+            from ..core.events import EDGE_ADD
+            ev = ev[kinds == EDGE_ADD]
+            val = log.props.column("num")[sel][kinds == EDGE_ADD]
+            # stored NaNs weigh 1.0 exactly like missing values
+            # (``SSSP.message``'s rule) — raw NaN would poison the whole
+            # column through the min-plus relaxation
+            val = np.where(np.isnan(val), 1.0, val)
+            tt = log.column("time")[ev]
+            order = np.lexsort((ev, tt))
+            self._w_t = tt[order]
+            self._w_val = val[order].astype(np.float32)
+            enc = self.sw._pack(self.sw._dense(log.column("src")[ev]),
+                                self.sw._dense(log.column("dst")[ev]))
+            self._w_pos = t.eng_pos(enc)[order]
+        else:
+            self._w_t = np.empty(0, np.int64)
+            self._w_val = np.empty(0, np.float32)
+            self._w_pos = np.empty(0, np.int64)
+        self._w_cursor = 0
+
+    def _weight_cols(self, hop_times):
+        t = self.tables
+        H = len(hop_times)
+        W = np.empty((H, t.m_pad), np.float32)
+        for j, T in enumerate(hop_times):
+            hi = int(np.searchsorted(self._w_t, T, side="right"))
+            if hi > self._w_cursor:
+                # ascending (time, row) order: last write = latest value
+                self._w_state[self._w_pos[self._w_cursor:hi]] = \
+                    self._w_val[self._w_cursor:hi]
+                self._w_cursor = hi
+            W[j] = self._w_state
+        return W
+
+    def _fold_columns(self, hop_times, hop_callback=None):
+        hop_times, cols = super()._fold_columns(hop_times, hop_callback)
+        return hop_times, (*cols, self._weight_cols(hop_times))
+
+    def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
+        assert r_init is None   # guarded by supports_warm_start
+        *base, wcols = cols
+        return run_bfs_columns(
+            self.tables, *base, hop_times, windows, self.seeds,
+            directed=self.directed, max_steps=self.max_steps,
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst,
+            weight_cols=wcols)
 
 
 class HopBatchedCC(_HopBatched):

@@ -227,7 +227,7 @@ class Job:
         from ..algorithms import PageRank as _PR
         from ..algorithms.traversal import SSSP as _SSSP
         from ..engine.hopbatch import (HopBatchedBFS, HopBatchedCC,
-                                       HopBatchedPageRank)
+                                       HopBatchedPageRank, HopBatchedSSSP)
 
         if self.mesh is not None or self.graph.safe_time() < q.end:
             return False
@@ -244,13 +244,19 @@ class Job:
                                         tol=p.tol, max_steps=p.max_steps)
             elif type(p) is _CC:
                 hb = HopBatchedCC(self.graph.log, max_steps=p.max_steps)
-            elif type(p) is _SSSP and not p.weight_prop:
-                # unit-weight traversal (BFS) — the columnar distances are
-                # exactly SSSP's finalize output; weighted SSSP needs edge
-                # property joins and stays on the per-view path
-                hb = HopBatchedBFS(self.graph.log, p.seeds,
-                                   directed=p.directed,
-                                   max_steps=p.max_steps)
+            elif type(p) is _SSSP:
+                # the columnar distances are exactly SSSP's finalize
+                # output; weighted traversal folds per-hop weight columns
+                # (immutable weight keys raise -> per-view path below)
+                if p.weight_prop:
+                    hb = HopBatchedSSSP(self.graph.log, p.seeds,
+                                        p.weight_prop,
+                                        directed=p.directed,
+                                        max_steps=p.max_steps)
+                else:
+                    hb = HopBatchedBFS(self.graph.log, p.seeds,
+                                       directed=p.directed,
+                                       max_steps=p.max_steps)
             else:
                 return False
         except ValueError:

@@ -184,3 +184,78 @@ def test_hopbatch_warm_start_matches_cold_within_tol():
 
     with pytest.raises(ValueError, match="warm-start"):
         HopBatchedCC(log).run(hops, windows, chunks=3, warm_start=True)
+
+
+def test_hopbatch_weighted_sssp_matches_per_view():
+    from raphtory_tpu.algorithms import SSSP
+    from raphtory_tpu.core.events import EventLog
+    from raphtory_tpu.engine.hopbatch import HopBatchedSSSP
+
+    rng = np.random.default_rng(8)
+    n = 700
+    src = rng.integers(0, 40, n)
+    dst = rng.integers(0, 40, n)
+    times = np.sort(rng.integers(0, 90, n))   # ties exercise the
+    log = EventLog()                          # (time, row) tie-break
+    log.append_batch(
+        times, np.full(n, 2, np.uint8), src.astype(np.int64),
+        dst.astype(np.int64),
+        props=[(i, {"weight": float(rng.uniform(0.5, 3.0))})
+               for i in range(n)])
+    hops = [30, 60, 89]
+    windows = [1000, 25]
+    seeds = (0, 1, 2)
+    hb = HopBatchedSSSP(log, seeds, "weight", directed=False, max_steps=60)
+    dist, _ = hb.run(hops, windows)
+    dist = np.asarray(dist)
+
+    prog = SSSP(seeds=seeds, weight_prop="weight", directed=False,
+                max_steps=60)
+    for j, T in enumerate(hops):
+        view = build_view(log, T)
+        want, _ = bsp.run(prog, view, windows=windows)
+        for i, w in enumerate(windows):
+            col = dist[j * len(windows) + i]
+            mask = view.window_masks([w])[0][0]
+            for vi, vid in enumerate(view.vids):
+                if not mask[vi]:
+                    continue
+                p = int(np.searchsorted(hb.tables.uv, vid))
+                a = float(np.asarray(want)[i, vi])
+                b = float(col[p])
+                assert (np.isinf(a) and np.isinf(b)) or \
+                    a == pytest.approx(b, abs=1e-5), (T, w, int(vid), a, b)
+
+
+def test_hopbatch_weighted_sssp_rejects_immutable_key():
+    from raphtory_tpu.core.events import EventLog
+    from raphtory_tpu.engine.hopbatch import HopBatchedSSSP
+
+    log = EventLog()
+    log.append_batch(np.array([1, 2]), np.full(2, 2, np.uint8),
+                     np.array([0, 1]), np.array([1, 2]),
+                     props=[(0, {"!weight": 2.0}), (1, {"!weight": 3.0})])
+    with pytest.raises(ValueError, match="immutable"):
+        HopBatchedSSSP(log, (0,), "weight")
+
+
+def test_hopbatch_weighted_sssp_treats_stored_nan_as_unit():
+    """An explicitly-stored NaN weight must weigh 1.0 (SSSP.message's
+    rule), not poison the min-plus relaxation."""
+    from raphtory_tpu.algorithms import SSSP
+    from raphtory_tpu.core.events import EventLog
+    from raphtory_tpu.engine.hopbatch import HopBatchedSSSP
+
+    log = EventLog()
+    log.append_batch(np.array([1, 2]), np.full(2, 2, np.uint8),
+                     np.array([0, 1]), np.array([1, 2]),
+                     props=[(0, {"weight": float("nan")}),
+                            (1, {"weight": 2.0})])
+    hb = HopBatchedSSSP(log, (0,), "weight", directed=True, max_steps=10)
+    dist = np.asarray(hb.run([5], [1000])[0])[0]
+    view = build_view(log, 5)
+    want, _ = bsp.run(SSSP(seeds=(0,), weight_prop="weight", directed=True,
+                           max_steps=10), view, windows=[1000])
+    for vi, vid in enumerate(view.vids[: view.n_active]):
+        p = int(np.searchsorted(hb.tables.uv, vid))
+        assert float(np.asarray(want)[0, vi]) == float(dist[p]), int(vid)

@@ -404,3 +404,43 @@ def test_range_bfs_on_device_sweep_matches_view_jobs(monkeypatch):
     assert job.status == "done", job.error
     assert taken == [True], "device-resident route was not taken"
     _assert_range_rows_match_view_jobs(job, bfs, mgr)
+
+
+def test_range_weighted_sssp_rides_hopbatch_and_matches_view_jobs(
+        monkeypatch):
+    from raphtory_tpu.engine import hopbatch
+
+    calls = []
+    orig = hopbatch.HopBatchedSSSP.run
+
+    def spy(self, *a, **kw):
+        calls.append(1)
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(hopbatch.HopBatchedSSSP, "run", spy)
+    pipe = IngestionPipeline()
+    rng = np.random.default_rng(5)
+    updates = [
+        EdgeAdd(int(t), int(a), int(b),
+                props={"weight": float(rng.uniform(0.5, 3.0))})
+        for t, a, b in zip(np.sort(rng.integers(0, 100, 300)),
+                           rng.integers(0, 30, 300),
+                           rng.integers(0, 30, 300))
+    ]
+    pipe.add_source(IterableSource(updates, name="w"))
+    pipe.run()
+    g = TemporalGraph(pipe.log, pipe.watermarks)
+    mgr = AnalysisManager(g)
+
+    def sssp():
+        return registry.resolve(
+            "SSSP", {"seeds": (0, 1), "weight_prop": "weight",
+                     "directed": False, "max_steps": 60})
+
+    q = RangeQuery(start=20, end=90, jump=10, windows=(100, 25))
+    job = mgr.submit(sssp(), q)
+    assert job.wait(60)
+    assert job.status == "done", job.error
+    assert calls, "hopbatch weighted-SSSP route was not taken"
+    assert len(job.results) == 8 * 2
+    _assert_range_rows_match_view_jobs(job, sssp, mgr)
