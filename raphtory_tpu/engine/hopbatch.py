@@ -104,8 +104,14 @@ def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
         step, _, halted = carry
         return (step < max_steps) & ~jnp.all(halted)
 
+    # seed the non-array carry components from mv (numeric no-ops): under
+    # shard_map(check_vma=True) on a column-sharded mesh the loop carry
+    # must enter with the same varying-axes type it leaves with, and both
+    # step and halted become column-varying through the halting logic
+    seed_false = mv[0] & False                                 # all-False
+    step0 = jnp.int32(0) + (mv[0, 0] & False).astype(jnp.int32)
     steps, r, _ = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), r0, jnp.zeros((C,), bool)))
+        cond, body, (step0, r0, seed_false))
     return r.T, steps   # [C, n_pad], hop-major columns
 
 
@@ -267,9 +273,24 @@ class _HopBatched:
         #: host seconds spent folding + writing columns in the LAST run()
         #: (callers report it as snapshot-build time)
         self.fold_seconds = 0.0
-        # static edge tables upload once, like DeviceSweep
-        self._e_src = jnp.asarray(self.tables.e_src)
-        self._e_dst = jnp.asarray(self.tables.e_dst)
+        # static edge tables upload LAZILY on the first dispatch (callers
+        # that only use the host fold — e.g. the column-sharded mesh
+        # route — never pay the device transfer), then cache
+        self._edges = None
+
+    @property
+    def _e_src(self):
+        if self._edges is None:
+            self._edges = (jnp.asarray(self.tables.e_src),
+                           jnp.asarray(self.tables.e_dst))
+        return self._edges[0]
+
+    @property
+    def _e_dst(self):
+        if self._edges is None:
+            self._edges = (jnp.asarray(self.tables.e_src),
+                           jnp.asarray(self.tables.e_dst))
+        return self._edges[1]
 
     #: set True by subclasses whose iteration is a contraction (safe to
     #: warm-start from the previous chunk's solution)

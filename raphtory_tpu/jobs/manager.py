@@ -22,6 +22,10 @@ from ..engine import bsp
 from ..engine.program import VertexProgram
 from ..obs.metrics import METRICS
 
+import logging
+
+_jobs_log = logging.getLogger(__name__)
+
 
 @dataclass(frozen=True)
 class ViewQuery:
@@ -111,7 +115,8 @@ class Job:
                 # the static global-space partition (parallel/sweep.py), on
                 # one device the device-resident sweep (engine/device_sweep)
                 # — fold state stays on the chip, hops ship O(delta) bytes.
-                if not (self._try_range_mesh(q)
+                if not (self._try_range_mesh_columns(q)
+                        or self._try_range_mesh(q)
                         or self._try_range_hopbatch(q)
                         or self._try_range_device(q)):
                     sweep = None
@@ -209,18 +214,47 @@ class Job:
         self._range_amortised(q, sweep.advance, run, sweep.reduce_view)
         return True
 
+    def _columnar_range_prep(self, q: RangeQuery, build):
+        """Shared eligibility + construction for the columnar range routes
+        (single-device hopbatch and column-sharded mesh). Returns
+        ``(hops, windows, hb)`` or None; ``build()`` constructs the engine
+        and ANY construction failure (immutable weight key, >2^31 vertex
+        packing, device OOM on a graph sized for vertex sharding, ...)
+        falls back to the other routes rather than failing the job."""
+        hops = list(range(int(q.start), int(q.end) + 1, int(q.jump)))
+        windows = list(q.windows) if q.windows is not None else [q.window]
+        if not hops or len(hops) * len(windows) > 1024:
+            return None   # the cheap guard — before paying for tables
+        # upper-bound pre-guard: unique pairs never exceed events, so an
+        # event count already far over the state guard cannot fit the
+        # columnar paths — skip the throwaway table build entirely
+        if len(hops) * len(self.graph.log) > 1 << 30:
+            return None
+        try:
+            hb = build()
+        except Exception as e:
+            _jobs_log.debug("columnar range route declined: %s: %s",
+                            type(e).__name__, e)
+            return None
+        # columnar state is O(hops * (m_pad + n_pad)) on host — big graphs
+        # with long ranges stay on the O(1)-memory-per-hop paths instead
+        # (which rebuild their own tables; a rejected mid-size range pays
+        # the table build twice, acceptably rare at this guard size)
+        if len(hops) * (hb.tables.m_pad + hb.tables.n_pad) > 1 << 28:
+            return None
+        return hops, windows, hb
+
     def _try_range_hopbatch(self, q: RangeQuery) -> bool:
-        """Whole-range columnar dispatch for PageRank Range queries: every
-        (hop, window) view of the range is a COLUMN of one compiled program
-        (``engine/hopbatch``), pipelined in equal hop chunks with
-        warm-started columns — against the reference's full per-hop actor
-        handshake (``RangeAnalysisTask.scala:18-35``). PageRank (finalize
-        is the raw rank vector; power iteration warm-starts safely) and
+        """Whole-range columnar dispatch for qualifying Range queries:
+        every (hop, window) view of the range is a COLUMN of one compiled
+        program (``engine/hopbatch``), pipelined in equal hop chunks —
+        against the reference's full per-hop actor handshake
+        (``RangeAnalysisTask.scala:18-35``). Routes: PageRank (finalize is
+        the raw rank vector; the power iteration warm-starts safely),
         ConnectedComponents (labels are global padded indices in both
         engines; no warm start — min-propagation is not a contraction on a
-        changing edge set). ``viewTime`` on emitted rows is the AMORTISED
-        share of the one dispatch (plus that row's own reduce), not a
-        per-hop wall time."""
+        changing edge set), and SSSP/BFS (unit or mutable-numeric-weighted;
+        no warm start)."""
         import numpy as np
 
         from ..algorithms import ConnectedComponents as _CC
@@ -231,44 +265,34 @@ class Job:
 
         if self.mesh is not None or self.graph.safe_time() < q.end:
             return False
-        hops = list(range(int(q.start), int(q.end) + 1, int(q.jump)))
-        windows = list(q.windows) if q.windows is not None else [q.window]
-        W = len(windows)
-        if not hops or len(hops) * W > 1024:
-            # the cheap half of the size guard — before paying for tables
-            return False
         p = self.program
-        try:
+
+        def build():
             if type(p) is _PR:
-                hb = HopBatchedPageRank(self.graph.log, damping=p.damping,
-                                        tol=p.tol, max_steps=p.max_steps)
-            elif type(p) is _CC:
-                hb = HopBatchedCC(self.graph.log, max_steps=p.max_steps)
-            elif type(p) is _SSSP:
+                return HopBatchedPageRank(self.graph.log, damping=p.damping,
+                                          tol=p.tol, max_steps=p.max_steps)
+            if type(p) is _CC:
+                return HopBatchedCC(self.graph.log, max_steps=p.max_steps)
+            if type(p) is _SSSP:
                 # the columnar distances are exactly SSSP's finalize
                 # output; weighted traversal folds per-hop weight columns
-                # (immutable weight keys raise -> per-view path below)
+                # (immutable weight keys raise -> per-view path)
                 if p.weight_prop:
-                    hb = HopBatchedSSSP(self.graph.log, p.seeds,
-                                        p.weight_prop,
-                                        directed=p.directed,
-                                        max_steps=p.max_steps)
-                else:
-                    hb = HopBatchedBFS(self.graph.log, p.seeds,
-                                       directed=p.directed,
-                                       max_steps=p.max_steps)
-            else:
-                return False
-        except ValueError:
-            return False  # >2^31 distinct vertices: packed keys exhausted
+                    return HopBatchedSSSP(self.graph.log, p.seeds,
+                                          p.weight_prop,
+                                          directed=p.directed,
+                                          max_steps=p.max_steps)
+                return HopBatchedBFS(self.graph.log, p.seeds,
+                                     directed=p.directed,
+                                     max_steps=p.max_steps)
+            raise TypeError(f"no columnar engine for {type(p).__name__}")
+
+        prep = self._columnar_range_prep(q, build)
+        if prep is None:
+            return False
+        hops, windows, hb = prep
         if self._kill.is_set():
             return True
-        # columnar state is O(hops * (m_pad + n_pad)) on host — big graphs
-        # with long ranges stay on the O(1)-memory-per-hop device-resident
-        # path instead (which rebuilds its own tables; a rejected range
-        # pays the table build twice, acceptably rare at this guard size)
-        if len(hops) * (hb.tables.m_pad + hb.tables.n_pad) > 1 << 28:
-            return False
 
         shells = []
 
@@ -282,20 +306,70 @@ class Job:
                               warm_start=chunks > 1
                               and hb.supports_warm_start,
                               hop_callback=grab_shell)
-        ranks = np.asarray(ranks)   # blocks on the device result
-        steps = int(steps)
-        elapsed = _time.perf_counter() - t0
-        per_row = elapsed / (len(hops) * W)
-        for _ in hops:   # per-hop share of the measured incremental fold
+        self._emit_columnar(hops, windows, np.asarray(ranks), shells,
+                            int(steps), _time.perf_counter() - t0,
+                            hb.fold_seconds)
+        return True
+
+    def _emit_columnar(self, hops, windows, ranks, shells, steps, elapsed,
+                       fold_seconds) -> None:
+        """Emit one result row per (hop, window) column of a whole-range
+        dispatch: viewTime is the AMORTISED share of the dispatch (plus
+        that row's own reduce), snapshot-build is the per-hop share of the
+        measured incremental fold."""
+        W = len(windows)
+        per_row = elapsed / max(len(hops) * W, 1)
+        for _ in hops:
             METRICS.snapshot_build_seconds.observe(
-                hb.fold_seconds / len(hops))
+                fold_seconds / max(len(hops), 1))
         METRICS.supersteps.inc(max(steps, 0))
         for j, T in enumerate(hops):
             if self._kill.is_set():
-                return True
+                return
             for i, w in enumerate(windows):
                 self._emit(T, w, ranks[j * W + i], shells[j], steps,
                            _time.perf_counter() - per_row)
+
+    def _try_range_mesh_columns(self, q: RangeQuery) -> bool:
+        """View-axis mesh parallelism for PageRank Range queries: the
+        (hop, window) columns spread COLLECTIVE-FREE over every device of
+        the mesh (``parallel/columns.py``) — the graph tables replicate,
+        so this route takes ranges whose graph fits one chip; bigger
+        graphs fall through to the vertex-sharded ``_try_range_mesh``."""
+        import numpy as np
+
+        from ..algorithms import PageRank as _PR
+        from ..engine.hopbatch import HopBatchedPageRank
+        from ..parallel.columns import run_columns_sharded
+
+        if self.mesh is None or self.graph.safe_time() < q.end:
+            return False
+        p = self.program
+        if type(p) is not _PR:
+            return False
+        prep = self._columnar_range_prep(
+            q, lambda: HopBatchedPageRank(self.graph.log, damping=p.damping,
+                                          tol=p.tol, max_steps=p.max_steps))
+        if prep is None:
+            return False
+        hops, windows, hb = prep
+        if self._kill.is_set():
+            return True
+
+        shells = []
+
+        def grab_shell(T, sw):
+            shells.append(_shell_from_fold(hb.tables, sw, int(T)))
+
+        t0 = _time.perf_counter()
+        _, cols = hb._fold_columns(hops, grab_shell)
+        ranks, steps = run_columns_sharded(
+            hb.tables, *cols, hops, windows,
+            self.mesh.devices.ravel(), damping=p.damping, tol=p.tol,
+            max_steps=p.max_steps)
+        self._emit_columnar(hops, windows, np.asarray(ranks), shells,
+                            int(steps), _time.perf_counter() - t0,
+                            hb.fold_seconds)
         return True
 
     def _try_range_device(self, q: RangeQuery) -> bool:
