@@ -131,91 +131,122 @@ def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
     return jax.jit(run)
 
 
-@functools.lru_cache(maxsize=64)
-def _compiled_cc(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
-                 tdt: str):
+def _cc_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int):
     """Columnar min-label propagation — connected components for every
     (hop, window) column at once (semantics of
     ``algorithms/connected_components.py``: undirected min over both
-    directions, labels are global padded indices)."""
-    tdt = jnp.dtype(tdt)
+    directions, labels are global padded indices). Shared by the
+    single-device kernel and the column-sharded mesh runner."""
     I32_MAX = jnp.iinfo(jnp.int32).max
+    lab0 = jnp.where(mv, jnp.arange(n_pad, dtype=jnp.int32)[:, None],
+                     I32_MAX)
+
+    def body(carry):
+        step, lab, halted = carry
+
+        def pull(idx_from, idx_to, sorted_):
+            payload = jnp.where(me, lab[idx_from, :], I32_MAX)
+            return jax.ops.segment_min(
+                payload, idx_to, num_segments=n_pad,
+                indices_are_sorted=sorted_)
+
+        agg = jnp.minimum(pull(e_src, e_dst, True),
+                          pull(e_dst, e_src, False))
+        new = jnp.where(mv, jnp.minimum(lab, agg), I32_MAX)
+        col_done = jnp.all(new == lab, axis=0)
+        new = jnp.where(halted[None, :], lab, new)
+        return step + 1, new, halted | col_done
+
+    def cond(carry):
+        step, _, halted = carry
+        return (step < max_steps) & ~jnp.all(halted)
+
+    # vma-safe carry seeds, as in _pagerank_columns
+    steps, lab, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0) + (mv[0, 0] & False).astype(jnp.int32),
+         lab0, mv[0] & False))
+    return lab.T, steps   # [C, n_pad]
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_cc(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
+                 tdt: str):
+    tdt = jnp.dtype(tdt)
 
     def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
             hop_of_col, T_col, w_col):
         me, mv = _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
                                hop_of_col, T_col, w_col)
-        lab0 = jnp.where(mv, jnp.arange(n_pad, dtype=jnp.int32)[:, None],
-                         I32_MAX)
-
-        def body(carry):
-            step, lab, halted = carry
-            def pull(idx_from, idx_to, sorted_):
-                payload = jnp.where(me, lab[idx_from, :], I32_MAX)
-                return jax.ops.segment_min(
-                    payload, idx_to, num_segments=n_pad,
-                    indices_are_sorted=sorted_)
-            agg = jnp.minimum(pull(e_src, e_dst, True),
-                              pull(e_dst, e_src, False))
-            new = jnp.where(mv, jnp.minimum(lab, agg), I32_MAX)
-            col_done = jnp.all(new == lab, axis=0)
-            new = jnp.where(halted[None, :], lab, new)
-            return step + 1, new, halted | col_done
-
-        def cond(carry):
-            step, _, halted = carry
-            return (step < max_steps) & ~jnp.all(halted)
-
-        steps, lab, _ = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), lab0, jnp.zeros((C,), bool)))
-        return lab.T, steps   # [C, n_pad]
+        return _cc_columns(me, mv, e_src, e_dst, n_pad, max_steps)
 
     return jax.jit(run)
+
+
+def _bfs_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
+                 directed: bool, seed_mask, ew):
+    """Columnar min-plus traversal (``algorithms/traversal.SSSP``
+    semantics); ``ew`` is 1.0 for hop counting or [m_pad, C] f32 weights.
+    Shared by the single-device kernel and the column-sharded runner."""
+    INF = jnp.float32(jnp.inf)
+    d0 = jnp.where(mv & seed_mask[:, None], 0.0, INF)
+
+    def body(carry):
+        step, dist, halted = carry
+
+        def pull(idx_from, idx_to, sorted_):
+            payload = jnp.where(me, dist[idx_from, :] + ew, INF)
+            return jax.ops.segment_min(
+                payload, idx_to, num_segments=n_pad,
+                indices_are_sorted=sorted_)
+
+        agg = pull(e_src, e_dst, True)
+        if not directed:
+            agg = jnp.minimum(agg, pull(e_dst, e_src, False))
+        new = jnp.where(mv, jnp.minimum(dist, agg), INF)
+        col_done = jnp.all(new == dist, axis=0)
+        new = jnp.where(halted[None, :], dist, new)
+        return step + 1, new, halted | col_done
+
+    def cond(carry):
+        step, _, halted = carry
+        return (step < max_steps) & ~jnp.all(halted)
+
+    # vma-safe carry seeds, as in _pagerank_columns
+    steps, dist, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0) + (mv[0, 0] & False).astype(jnp.int32),
+         d0, mv[0] & False))
+    return dist.T, steps   # [C, n_pad]
 
 
 @functools.lru_cache(maxsize=64)
 def _compiled_bfs(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
                   directed: bool, tdt: str, weighted: bool = False):
-    """Columnar min-plus traversal from seed vertices — semantics of
-    ``algorithms/traversal.SSSP``: unit weights (BFS hop counting) by
-    default; ``weighted=True`` takes hop-major per-edge weight columns
-    (``[H, m_pad]`` f32, missing values pre-folded to 1.0)."""
     tdt = jnp.dtype(tdt)
-    INF = jnp.float32(jnp.inf)
 
     def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
             hop_of_col, T_col, w_col, seed_mask, *rest):
         me, mv = _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
                                hop_of_col, T_col, w_col)
         ew = rest[0][hop_of_col].T if weighted else 1.0   # [m_pad, C]
-        d0 = jnp.where(mv & seed_mask[:, None], 0.0, INF)
-
-        def body(carry):
-            step, dist, halted = carry
-
-            def pull(idx_from, idx_to, sorted_):
-                payload = jnp.where(me, dist[idx_from, :] + ew, INF)
-                return jax.ops.segment_min(
-                    payload, idx_to, num_segments=n_pad,
-                    indices_are_sorted=sorted_)
-
-            agg = pull(e_src, e_dst, True)
-            if not directed:
-                agg = jnp.minimum(agg, pull(e_dst, e_src, False))
-            new = jnp.where(mv, jnp.minimum(dist, agg), INF)
-            col_done = jnp.all(new == dist, axis=0)
-            new = jnp.where(halted[None, :], dist, new)
-            return step + 1, new, halted | col_done
-
-        def cond(carry):
-            step, _, halted = carry
-            return (step < max_steps) & ~jnp.all(halted)
-
-        steps, dist, _ = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), d0, jnp.zeros((C,), bool)))
-        return dist.T, steps   # [C, n_pad]
+        return _bfs_columns(me, mv, e_src, e_dst, n_pad, max_steps,
+                            directed, seed_mask, ew)
 
     return jax.jit(run)
+
+
+def _seed_mask(tables, seed_vids) -> np.ndarray:
+    """Global dense-space seed mask from external vertex ids (absent ids
+    ignored)."""
+    seed_mask = np.zeros(tables.n_pad, bool)
+    seeds = np.asarray(sorted({int(v) for v in seed_vids}), np.int64)
+    if len(seeds) and len(tables.uv):
+        pos = np.clip(np.searchsorted(tables.uv, seeds), 0,
+                      len(tables.uv) - 1)
+        ok = tables.uv[pos] == seeds
+        seed_mask[pos[ok]] = True
+    return seed_mask
 
 
 def run_bfs_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
@@ -227,13 +258,7 @@ def run_bfs_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
     space (absent ids ignored). ``weight_cols`` ([H, m_pad] f32, missing
     folded to 1.0) turns hop counting into weighted SSSP."""
     H, C, hop_of_col, T_col, w_col = _column_layout(hop_times, windows)
-    seed_mask = np.zeros(tables.n_pad, bool)
-    seeds = np.asarray(sorted({int(v) for v in seed_vids}), np.int64)
-    if len(seeds) and len(tables.uv):
-        pos = np.clip(np.searchsorted(tables.uv, seeds), 0,
-                      len(tables.uv) - 1)
-        ok = tables.uv[pos] == seeds
-        seed_mask[pos[ok]] = True
+    seed_mask = _seed_mask(tables, seed_vids)
     runner = _compiled_bfs(tables.n_pad, tables.m_pad, H, C, int(max_steps),
                            bool(directed), np.dtype(tables.tdtype).name,
                            weight_cols is not None)

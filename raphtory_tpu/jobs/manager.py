@@ -214,32 +214,57 @@ class Job:
         self._range_amortised(q, sweep.advance, run, sweep.reduce_view)
         return True
 
-    def _columnar_range_prep(self, q: RangeQuery, build):
+    def _columnar_builder(self):
+        """Construct the hop-batched columnar engine for this job's
+        program (raises for programs without one — the caller treats any
+        failure as \'route declined\'). PageRank: finalize is the raw rank
+        vector and the power iteration warm-starts safely. CC: labels are
+        global padded indices in both engines. SSSP/BFS: the columnar
+        distances are exactly finalize's output; weighted traversal folds
+        per-hop weight columns (immutable weight keys raise)."""
+        from ..algorithms import ConnectedComponents as _CC
+        from ..algorithms import PageRank as _PR
+        from ..algorithms.traversal import SSSP as _SSSP
+        from ..engine.hopbatch import (HopBatchedBFS, HopBatchedCC,
+                                       HopBatchedPageRank, HopBatchedSSSP)
+
+        p = self.program
+        if type(p) is _PR:
+            return HopBatchedPageRank(self.graph.log, damping=p.damping,
+                                      tol=p.tol, max_steps=p.max_steps)
+        if type(p) is _CC:
+            return HopBatchedCC(self.graph.log, max_steps=p.max_steps)
+        if type(p) is _SSSP:
+            if p.weight_prop:
+                return HopBatchedSSSP(self.graph.log, p.seeds,
+                                      p.weight_prop, directed=p.directed,
+                                      max_steps=p.max_steps)
+            return HopBatchedBFS(self.graph.log, p.seeds,
+                                 directed=p.directed,
+                                 max_steps=p.max_steps)
+        raise TypeError(f"no columnar engine for {type(p).__name__}")
+
+    def _columnar_range_prep(self, q: RangeQuery):
         """Shared eligibility + construction for the columnar range routes
         (single-device hopbatch and column-sharded mesh). Returns
-        ``(hops, windows, hb)`` or None; ``build()`` constructs the engine
-        and ANY construction failure (immutable weight key, >2^31 vertex
-        packing, device OOM on a graph sized for vertex sharding, ...)
-        falls back to the other routes rather than failing the job."""
+        ``(hops, windows, hb)`` or None; enumerable construction failures
+        (TypeError: no columnar engine for the program; ValueError:
+        immutable weight key / >2^31 vertex packing; MemoryError) decline
+        the route rather than failing the job."""
         hops = list(range(int(q.start), int(q.end) + 1, int(q.jump)))
         windows = list(q.windows) if q.windows is not None else [q.window]
         if not hops or len(hops) * len(windows) > 1024:
             return None   # the cheap guard — before paying for tables
-        # upper-bound pre-guard: unique pairs never exceed events, so an
-        # event count already far over the state guard cannot fit the
-        # columnar paths — skip the throwaway table build entirely
-        if len(hops) * len(self.graph.log) > 1 << 30:
-            return None
         try:
-            hb = build()
-        except Exception as e:
-            _jobs_log.debug("columnar range route declined: %s: %s",
-                            type(e).__name__, e)
+            hb = self._columnar_builder()
+        except (TypeError, ValueError, MemoryError) as e:
+            _jobs_log.info("columnar range route declined: %s: %s",
+                           type(e).__name__, e)
             return None
         # columnar state is O(hops * (m_pad + n_pad)) on host — big graphs
         # with long ranges stay on the O(1)-memory-per-hop paths instead
-        # (which rebuild their own tables; a rejected mid-size range pays
-        # the table build twice, acceptably rare at this guard size)
+        # (which rebuild their own tables; a rejected range pays the table
+        # build twice, acceptable next to the sweep it avoids misrouting)
         if len(hops) * (hb.tables.m_pad + hb.tables.n_pad) > 1 << 28:
             return None
         return hops, windows, hb
@@ -257,37 +282,9 @@ class Job:
         no warm start)."""
         import numpy as np
 
-        from ..algorithms import ConnectedComponents as _CC
-        from ..algorithms import PageRank as _PR
-        from ..algorithms.traversal import SSSP as _SSSP
-        from ..engine.hopbatch import (HopBatchedBFS, HopBatchedCC,
-                                       HopBatchedPageRank, HopBatchedSSSP)
-
         if self.mesh is not None or self.graph.safe_time() < q.end:
             return False
-        p = self.program
-
-        def build():
-            if type(p) is _PR:
-                return HopBatchedPageRank(self.graph.log, damping=p.damping,
-                                          tol=p.tol, max_steps=p.max_steps)
-            if type(p) is _CC:
-                return HopBatchedCC(self.graph.log, max_steps=p.max_steps)
-            if type(p) is _SSSP:
-                # the columnar distances are exactly SSSP's finalize
-                # output; weighted traversal folds per-hop weight columns
-                # (immutable weight keys raise -> per-view path)
-                if p.weight_prop:
-                    return HopBatchedSSSP(self.graph.log, p.seeds,
-                                          p.weight_prop,
-                                          directed=p.directed,
-                                          max_steps=p.max_steps)
-                return HopBatchedBFS(self.graph.log, p.seeds,
-                                     directed=p.directed,
-                                     max_steps=p.max_steps)
-            raise TypeError(f"no columnar engine for {type(p).__name__}")
-
-        prep = self._columnar_range_prep(q, build)
+        prep = self._columnar_range_prep(q)
         if prep is None:
             return False
         hops, windows, hb = prep
@@ -331,30 +328,34 @@ class Job:
                            _time.perf_counter() - per_row)
 
     def _try_range_mesh_columns(self, q: RangeQuery) -> bool:
-        """View-axis mesh parallelism for PageRank Range queries: the
+        """View-axis mesh parallelism for qualifying Range queries: the
         (hop, window) columns spread COLLECTIVE-FREE over every device of
         the mesh (``parallel/columns.py``) — the graph tables replicate,
         so this route takes ranges whose graph fits one chip; bigger
         graphs fall through to the vertex-sharded ``_try_range_mesh``."""
         import numpy as np
 
-        from ..algorithms import PageRank as _PR
-        from ..engine.hopbatch import HopBatchedPageRank
+        from ..engine.hopbatch import (HopBatchedCC, HopBatchedPageRank,
+                                       HopBatchedSSSP)
         from ..parallel.columns import run_columns_sharded
 
         if self.mesh is None or self.graph.safe_time() < q.end:
             return False
-        p = self.program
-        if type(p) is not _PR:
-            return False
-        prep = self._columnar_range_prep(
-            q, lambda: HopBatchedPageRank(self.graph.log, damping=p.damping,
-                                          tol=p.tol, max_steps=p.max_steps))
+        prep = self._columnar_range_prep(q)
         if prep is None:
             return False
         hops, windows, hb = prep
         if self._kill.is_set():
             return True
+
+        if isinstance(hb, HopBatchedPageRank):
+            kw = dict(kind="pagerank", damping=hb.damping, tol=hb.tol,
+                      max_steps=hb.max_steps)
+        elif isinstance(hb, HopBatchedCC):
+            kw = dict(kind="cc", max_steps=hb.max_steps)
+        else:
+            kw = dict(kind="bfs", seeds=hb.seeds, directed=hb.directed,
+                      max_steps=hb.max_steps)
 
         shells = []
 
@@ -363,11 +364,22 @@ class Job:
 
         t0 = _time.perf_counter()
         _, cols = hb._fold_columns(hops, grab_shell)
-        ranks, steps = run_columns_sharded(
-            hb.tables, *cols, hops, windows,
-            self.mesh.devices.ravel(), damping=p.damping, tol=p.tol,
-            max_steps=p.max_steps)
-        self._emit_columnar(hops, windows, np.asarray(ranks), shells,
+        if isinstance(hb, HopBatchedSSSP):
+            *cols, kw["weight_cols"] = cols
+        try:
+            ranks, steps = run_columns_sharded(
+                hb.tables, *cols, hops, windows,
+                self.mesh.devices.ravel(), **kw)
+            ranks = np.asarray(ranks)
+        except Exception as e:
+            # replicating the tables can exhaust one chip's HBM on graphs
+            # the host-side guard admits — fall through to the
+            # vertex-sharded route instead of failing the job
+            _jobs_log.warning("column-sharded mesh route failed (%s: %s) — "
+                              "falling back to vertex sharding",
+                              type(e).__name__, e)
+            return False
+        self._emit_columnar(hops, windows, ranks, shells,
                             int(steps), _time.perf_counter() - t0,
                             hb.fold_seconds)
         return True

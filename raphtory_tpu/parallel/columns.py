@@ -25,21 +25,25 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..engine.hopbatch import (_column_layout, _column_masks,
-                               _pagerank_columns)
+from ..engine.hopbatch import (_bfs_columns, _cc_columns, _column_layout,
+                               _column_masks, _pagerank_columns, _seed_mask)
 
 C_AXIS = "columns"
 
 
 def run_columns_sharded(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
-                        windows, devices, *, damping: float = 0.85,
-                        tol: float = 1e-7, max_steps: int = 20):
-    """Columnar PageRank with the (hop, window) axis sharded over
-    ``devices`` (any iterable of jax devices, e.g. a sharded.make_mesh's
-    ``mesh.devices.ravel()``). Returns ``(ranks [C, n_pad] hop-major,
-    steps)`` — identical values to the single-device
-    ``hopbatch.run_columns`` (tested); columns pad up to a device multiple
-    internally and the pad is dropped before returning."""
+                        windows, devices, *, kind: str = "pagerank",
+                        damping: float = 0.85, tol: float = 1e-7,
+                        max_steps: int = 20, seeds=(),
+                        directed: bool = False, weight_cols=None):
+    """Columnar sweep with the (hop, window) axis sharded over ``devices``
+    (any iterable of jax devices, e.g. ``mesh.devices.ravel()``).
+
+    ``kind``: ``"pagerank"`` | ``"cc"`` | ``"bfs"`` (``seeds``/``directed``
+    apply; pass ``weight_cols`` ([H, m_pad] f32) for weighted SSSP).
+    Returns ``(result [C, n_pad] hop-major, steps)`` — identical values to
+    the single-device ``hopbatch`` runners (tested); columns pad up to a
+    device multiple internally and the pad is dropped before returning."""
     devices = list(devices)
     n_dev = len(devices)
     H, C, hop_of_col, T_col, w_col = _column_layout(hop_times, windows)
@@ -54,25 +58,45 @@ def run_columns_sharded(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
     mesh = Mesh(np.asarray(devices), (C_AXIS,))
     tdt = jnp.dtype(np.dtype(tables.tdtype).name)
     n_pad = tables.n_pad
+    extra_host = []
+    extra_specs = []
+    if kind == "bfs":
+        extra_host.append(_seed_mask(tables, seeds))
+        extra_specs.append(P())
+        if weight_cols is not None:
+            extra_host.append(weight_cols)
+            extra_specs.append(P())
 
-    def block(e_src, e_dst, el, ea, vl, va, hoc, tc, wc):
+    def block(e_src, e_dst, el, ea, vl, va, hoc, tc, wc, *extra):
         me, mv = _column_masks(tdt, el, ea, vl, va, hoc, tc, wc)
-        ranks, steps = _pagerank_columns(me, mv, e_src, e_dst, n_pad,
-                                         float(damping), float(tol),
-                                         int(max_steps))
-        return ranks, steps[None]   # scalar -> [1] so steps concatenates
+        if kind == "pagerank":
+            out, steps = _pagerank_columns(me, mv, e_src, e_dst, n_pad,
+                                           float(damping), float(tol),
+                                           int(max_steps))
+        elif kind == "cc":
+            out, steps = _cc_columns(me, mv, e_src, e_dst, n_pad,
+                                     int(max_steps))
+        elif kind == "bfs":
+            ew = extra[1][hoc].T if len(extra) > 1 else 1.0
+            out, steps = _bfs_columns(me, mv, e_src, e_dst, n_pad,
+                                      int(max_steps), bool(directed),
+                                      extra[0], ew)
+        else:
+            raise ValueError(f"unknown columnar kind {kind!r}")
+        return out, steps[None]   # scalar -> [1] so steps concatenates
 
     shard = jax.jit(jax.shard_map(
         block, mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P(),   # tables replicate
-                  P(C_AXIS), P(C_AXIS), P(C_AXIS)),
+                  P(C_AXIS), P(C_AXIS), P(C_AXIS), *extra_specs),
         out_specs=(P(C_AXIS), P(C_AXIS)),
         check_vma=True))
 
     repl = NamedSharding(mesh, P())
     put = lambda a: jax.device_put(jnp.asarray(a), repl)
-    ranks, steps = shard(
+    result, steps = shard(
         put(tables.e_src), put(tables.e_dst), put(e_lat), put(e_alive),
         put(v_lat), put(v_alive),
-        jnp.asarray(hop_of_col), jnp.asarray(T_col), jnp.asarray(w_col))
-    return ranks[:C], int(np.max(np.asarray(steps)))
+        jnp.asarray(hop_of_col), jnp.asarray(T_col), jnp.asarray(w_col),
+        *(put(a) for a in extra_host))
+    return result[:C], int(np.max(np.asarray(steps)))

@@ -33,11 +33,58 @@ def test_column_sharded_matches_single_device(n_dev, windows):
     assert int(steps1) == steps2
 
 
+@pytest.mark.parametrize("kind", ["cc", "bfs", "sssp"])
+def test_column_sharded_cc_bfs_match_single_device(kind):
+    from raphtory_tpu.core.events import EventLog
+    from raphtory_tpu.engine.hopbatch import (HopBatchedBFS, HopBatchedCC,
+                                              HopBatchedSSSP)
+
+    rng = np.random.default_rng(7)
+    if kind == "sssp":
+        n = 700
+        src = rng.integers(0, 40, n)
+        dst = rng.integers(0, 40, n)
+        times = np.sort(rng.integers(0, 100, n))
+        log = EventLog()
+        log.append_batch(
+            times, np.full(n, 2, np.uint8), src.astype(np.int64),
+            dst.astype(np.int64),
+            props=[(i, {"weight": float(rng.uniform(0.5, 3.0))})
+                   for i in range(n)])
+    else:
+        log = random_log(rng, n_events=900, n_ids=50, t_span=100)
+    hops = [20, 40, 60, 80, 99]
+    windows = [1000, 30]
+    seeds = (0, 1, 2)
+    if kind == "cc":
+        hb = HopBatchedCC(log, max_steps=60)
+        kw = dict(kind="cc", max_steps=60)
+    elif kind == "bfs":
+        hb = HopBatchedBFS(log, seeds, directed=False, max_steps=50)
+        kw = dict(kind="bfs", seeds=seeds, directed=False, max_steps=50)
+    else:
+        hb = HopBatchedSSSP(log, seeds, "weight", directed=False,
+                            max_steps=50)
+        kw = dict(kind="bfs", seeds=seeds, directed=False, max_steps=50)
+    one, steps1 = hb.run(hops, windows)
+
+    hb2 = type(hb)(log, *( (seeds, "weight") if kind == "sssp"
+                           else (seeds,) if kind == "bfs" else ()),
+                   **({"directed": False, "max_steps": 50}
+                      if kind != "cc" else {"max_steps": 60}))
+    _, cols = hb2._fold_columns([int(x) for x in hops])
+    if kind == "sssp":
+        *cols, wcols = cols
+        kw["weight_cols"] = wcols
+    many, steps2 = run_columns_sharded(
+        hb2.tables, *cols, hops, windows, jax.devices(), **kw)
+    np.testing.assert_array_equal(np.asarray(one), np.asarray(many))
+    assert int(steps1) == steps2
+
+
 def test_mesh_pagerank_range_job_rides_column_sharding(monkeypatch):
     """With a mesh set, PageRank Range jobs take the view-axis route and
     agree with mesh-less per-view jobs."""
-    import sys
-    sys.path.insert(0, __file__.rsplit("/", 1)[0])
     from test_jobs import _graph
 
     from raphtory_tpu.jobs import manager as mgr_mod
@@ -85,3 +132,44 @@ def test_mesh_pagerank_range_job_rides_column_sharding(monkeypatch):
             assert set(ra) == set(rb)
             for k in ra:
                 assert ra[k] == pytest.approx(rb[k], abs=1e-5)
+
+
+def test_mesh_cc_range_job_rides_column_sharding(monkeypatch):
+    from test_jobs import _graph
+
+    from raphtory_tpu.jobs import manager as mgr_mod
+    from raphtory_tpu.jobs import registry
+    from raphtory_tpu.jobs.manager import (AnalysisManager, RangeQuery,
+                                           ViewQuery)
+    from raphtory_tpu.parallel import sharded
+
+    taken = []
+    orig = mgr_mod.Job._try_range_mesh_columns
+
+    def spy(self, q):
+        r = orig(self, q)
+        taken.append(r)
+        return r
+
+    monkeypatch.setattr(mgr_mod.Job, "_try_range_mesh_columns", spy)
+    g = _graph()
+    mgr = AnalysisManager(g, mesh=sharded.make_mesh(4, 2))
+
+    def cc():
+        return registry.resolve("ConnectedComponents", {"max_steps": 60})
+
+    job = mgr.submit(cc(), RangeQuery(start=20, end=90, jump=10,
+                                      windows=(100, 25)))
+    assert job.wait(120)
+    assert job.status == "done", job.error
+    assert taken == [True]
+
+    flat = AnalysisManager(g)
+    for t in (20, 90):
+        vjob = flat.submit(cc(), ViewQuery(t, windows=(100, 25)))
+        assert vjob.wait(60)
+        for vrow in vjob.results:
+            rrow = next(r for r in job.results
+                        if r["time"] == t
+                        and r["windowsize"] == vrow["windowsize"])
+            assert rrow["result"] == vrow["result"]
