@@ -259,3 +259,33 @@ def test_hopbatch_weighted_sssp_treats_stored_nan_as_unit():
     for vi, vid in enumerate(view.vids[: view.n_active]):
         p = int(np.searchsorted(hb.tables.uv, vid))
         assert float(np.asarray(want)[0, vi]) == float(dist[p]), int(vid)
+
+
+def test_hopbatch_weighted_sssp_chunked_matches_one_dispatch():
+    """The weight-fold cursor must continue correctly across pipelined
+    chunks (the LDBC bench runs weighted SSSP with chunks=5)."""
+    from raphtory_tpu.core.events import EventLog
+    from raphtory_tpu.engine.hopbatch import HopBatchedSSSP
+
+    rng = np.random.default_rng(14)
+    n = 800
+    src = rng.integers(0, 45, n)
+    dst = rng.integers(0, 45, n)
+    times = np.sort(rng.integers(0, 120, n))
+    log = EventLog()
+    log.append_batch(
+        times, np.full(n, 2, np.uint8), src.astype(np.int64),
+        dst.astype(np.int64),
+        props=[(i, {"weight": float(rng.uniform(0.5, 3.0))})
+               for i in range(n)])
+    hops = [20, 40, 60, 80, 100, 119]
+    windows = [1000, 30]
+    seeds = (0, 1)
+    one = np.asarray(HopBatchedSSSP(log, seeds, "weight", directed=False,
+                                    max_steps=60).run(hops, windows)[0])
+    for chunks in (2, 3):
+        many = np.asarray(
+            HopBatchedSSSP(log, seeds, "weight", directed=False,
+                           max_steps=60).run(hops, windows,
+                                             chunks=chunks)[0])
+        np.testing.assert_array_equal(one, many)
