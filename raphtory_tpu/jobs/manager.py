@@ -299,11 +299,21 @@ class Job:
         chunks = next((k for k in (4, 3, 2)
                        if len(hops) >= 2 * k and len(hops) % k == 0), 1)
         t0 = _time.perf_counter()
-        ranks, steps = hb.run(hops, windows, chunks=chunks,
-                              warm_start=chunks > 1
-                              and hb.supports_warm_start,
-                              hop_callback=grab_shell)
-        self._emit_columnar(hops, windows, np.asarray(ranks), shells,
+        try:
+            ranks, steps = hb.run(hops, windows, chunks=chunks,
+                                  warm_start=chunks > 1
+                                  and hb.supports_warm_start,
+                                  hop_callback=grab_shell)
+            ranks = np.asarray(ranks)
+        except Exception as e:
+            # a device failure mid-dispatch falls back to the
+            # O(1)-memory-per-hop device-resident route (which rebuilds
+            # its own state) instead of failing the job
+            _jobs_log.warning("columnar range route failed (%s: %s) — "
+                              "falling back to the per-hop path",
+                              type(e).__name__, e)
+            return False
+        self._emit_columnar(hops, windows, ranks, shells,
                             int(steps), _time.perf_counter() - t0,
                             hb.fold_seconds)
         return True
