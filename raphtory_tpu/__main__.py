@@ -52,14 +52,27 @@ def _serve(args) -> int:
     print(f"raphtory_tpu node up: REST :{settings.rest_port} "
           f"metrics :{settings.metrics_port}", flush=True)
 
+    def _ingest_summary():
+        # the event-TIME range is the operator's cheapest sanity check: a
+        # CSV parsed with the wrong column order (e.g. time,src,dst fed to
+        # the src,dst,time parser) ingests "successfully" with vertex ids
+        # as timestamps, and latest_time gives it away at a glance
+        n = sum(rt.pipeline.counts.values())
+        print(f"ingest done: {n} updates, "
+              f"event times [{rt.graph.log.column('time').min() if n else 0}"
+              f", {rt.graph.latest_time}], "
+              f"safe_time={rt.graph.safe_time()}", flush=True)
+
     rt.ingest(wait=False)
     if args.ingest_only:
         # default signal behaviour stays in place: Ctrl-C / SIGTERM abort
         # the blocking join instead of being swallowed by a no-op handler
         rt.pipeline.join()
-        print(f"ingest done: {sum(rt.pipeline.counts.values())} updates, "
-              f"safe_time={rt.graph.safe_time()}", flush=True)
+        _ingest_summary()
     else:
+        threading.Thread(target=lambda: (rt.pipeline.join(),
+                                         _ingest_summary()),
+                         daemon=True).start()
         stop = threading.Event()
         signal.signal(signal.SIGINT, lambda *a: stop.set())
         signal.signal(signal.SIGTERM, lambda *a: stop.set())
