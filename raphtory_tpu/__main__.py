@@ -52,15 +52,18 @@ def _serve(args) -> int:
     print(f"raphtory_tpu node up: REST :{settings.rest_port} "
           f"metrics :{settings.metrics_port}", flush=True)
 
-    def _ingest_summary():
+    def _ingest_summary(aborted=lambda: False):
         # the event-TIME range is the operator's cheapest sanity check: a
         # CSV parsed with the wrong column order (e.g. time,src,dst fed to
         # the src,dst,time parser) ingests "successfully" with vertex ids
-        # as timestamps, and latest_time gives it away at a glance
+        # as timestamps, and latest_time gives it away at a glance.
+        # earliest/latest are O(1) maintained marks, not column scans
         n = sum(rt.pipeline.counts.values())
-        print(f"ingest done: {n} updates, "
-              f"event times [{rt.graph.log.column('time').min() if n else 0}"
-              f", {rt.graph.latest_time}], "
+        rng = (f"event times [{rt.graph.earliest_time}, "
+               f"{rt.graph.latest_time}], " if len(rt.graph.log)
+               else "empty log, ")
+        word = "aborted" if aborted() else "done"
+        print(f"ingest {word}: {n} updates, {rng}"
               f"safe_time={rt.graph.safe_time()}", flush=True)
 
     rt.ingest(wait=False)
@@ -70,10 +73,11 @@ def _serve(args) -> int:
         rt.pipeline.join()
         _ingest_summary()
     else:
-        threading.Thread(target=lambda: (rt.pipeline.join(),
-                                         _ingest_summary()),
-                         daemon=True).start()
         stop = threading.Event()
+        threading.Thread(
+            target=lambda: (rt.pipeline.join(),
+                            _ingest_summary(aborted=stop.is_set)),
+            daemon=True).start()
         signal.signal(signal.SIGINT, lambda *a: stop.set())
         signal.signal(signal.SIGTERM, lambda *a: stop.set())
         stop.wait()
