@@ -33,7 +33,9 @@ class NodeRuntime:
         self.pipeline = IngestionPipeline(log=self.graph.log,
                                           watermarks=self.graph.watermarks)
         self.mesh = mesh
-        self.manager = AnalysisManager(self.graph, mesh=mesh)
+        self.manager = AnalysisManager(
+            self.graph, mesh=mesh, sink_dir=self.settings.sink_dir,
+            sink_format=self.settings.sink_format)
         self.archivist = Archivist(
             self.graph, max_events=self.settings.max_events,
             archive_fraction=self.settings.archive_fraction,

@@ -76,6 +76,9 @@ class Job:
         self.graph = graph
         self.mesh = mesh
         self.wait_timeout = wait_timeout
+        # ResultSink | None — attached by AnalysisManager.submit (the only
+        # path, so every sink went through the path jail + in-use check)
+        self.sink = None
         self.results: list[dict] = []
         self.status = "pending"
         self.error: str | None = None
@@ -137,6 +140,8 @@ class Job:
             self.status = "failed"  # reference's per-phase catches
             self.error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
         finally:
+            if self.sink is not None:
+                self.sink.close()   # flush partial output on kill/failure too
             METRICS.jobs_completed.labels(self.status).inc()
             self._done.set()
 
@@ -510,6 +515,8 @@ class Job:
             "result": reduced,
         }
         self.results.append(row)
+        if self.sink is not None:
+            self.sink.write(row)
 
 
 def _shell_from_fold(tables, sw, T):
@@ -554,16 +561,22 @@ class AnalysisManager:
     """Job registry + submission surface (``AnalysisManager.scala:49-70``
     job tracking for RequestResults/KillTask)."""
 
-    def __init__(self, graph: TemporalGraph, mesh=None):
+    def __init__(self, graph: TemporalGraph, mesh=None, sink_dir: str = "",
+                 sink_format: str = "jsonl"):
         self.graph = graph
         self.mesh = mesh
+        self.sink_dir = sink_dir       # "" disables file sinks (ref: unset
+        self.sink_format = sink_format  # env path in Utils.scala:107-126)
         self._jobs: dict[str, Job] = {}
         self._counter = itertools.count()
         self._lock = threading.Lock()
 
     def submit(self, program: VertexProgram, query: Query,
                job_id: str | None = None, mesh=None,
-               wait_timeout: float = 30.0) -> Job:
+               wait_timeout: float = 30.0, sink_name: str | None = None,
+               sink_format: str | None = None) -> Job:
+        from .sink import ResultSink, resolve_sink_path
+
         with self._lock:
             if job_id is None:
                 job_id = f"{type(program).__name__}_{next(self._counter)}"
@@ -573,6 +586,34 @@ class AnalysisManager:
                       mesh=mesh if mesh is not None else self.mesh,
                       wait_timeout=wait_timeout)
             self._jobs[job_id] = job
+        sink = None
+        try:
+            # disk I/O (mkdirs + open) stays OUTSIDE the registry lock;
+            # the job is registered but not started, so the sink attaches
+            # before any emit. Format rides the resolved suffix.
+            path = resolve_sink_path(self.sink_dir, job_id,
+                                     requested=sink_name,
+                                     fmt=sink_format or self.sink_format)
+            if path is not None:
+                sink = ResultSink(path)
+                with self._lock:
+                    # no two LIVE jobs share one file (interleaved rows);
+                    # sequential append to a finished job's file is fine.
+                    # Sinks only attach under this lock, so the check and
+                    # the attach are atomic.
+                    for other in self._jobs.values():
+                        if (other is not job and other.sink is not None
+                                and other.sink.path == sink.path
+                                and not other._done.is_set()):
+                            raise ValueError(
+                                f"sink path in use by job {other.id!r}")
+                    job.sink = sink
+        except BaseException:
+            if sink is not None:
+                sink.close()
+            with self._lock:
+                del self._jobs[job_id]
+            raise
         return job.start()
 
     def get(self, job_id: str) -> Job:

@@ -79,8 +79,17 @@ class _Handler(BaseHTTPRequestHandler):
                               bool(body.get("eventTime", False)),
                               int(max_runs) if max_runs is not None else None,
                               window, windows)
-            job = self.manager.submit(program, q, job_id=body.get("jobID"))
-            self._json(200, {"jobID": job.id, "status": job.status})
+            # sinkName is a file name resolved INSIDE the server's
+            # configured sink dir (jobs/sink.py) — absolute/escaping paths
+            # are rejected; with no sink dir configured it is ignored
+            job = self.manager.submit(
+                program, q, job_id=body.get("jobID"),
+                sink_name=body.get("sinkName"),
+                sink_format=body.get("sinkFormat"))
+            payload = {"jobID": job.id, "status": job.status}
+            if job.sink is not None:
+                payload["sinkPath"] = job.sink.path
+            self._json(200, payload)
         except (KeyError, ValueError, TypeError) as e:
             self._json(400, {"error": f"{type(e).__name__}: {e}"})
         except Exception as e:  # noqa: BLE001

@@ -69,6 +69,11 @@ class Settings:
     # checkpoint directory ("" disables; the Cassandra-saving analogue)
     checkpoint_dir: str = ""
 
+    # result sink directory ("" disables; Utils.scala:107-126 writes rows
+    # to an env-configured path — here one file per job under this dir)
+    sink_dir: str = ""
+    sink_format: str = "jsonl"   # default per-job format: jsonl | csv
+
     @classmethod
     def from_env(cls, prefix: str = "RAPHTORY_TPU_") -> "Settings":
         kw = {}

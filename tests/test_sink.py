@@ -1,0 +1,147 @@
+"""Result file sinks: rows stream to disk while also served in memory
+(ref: Utils.scala:107-126 writeLines; ConnectedComponents.scala JSON rows)."""
+
+import csv
+import json
+import time
+
+import numpy as np
+import pytest
+
+from raphtory_tpu.core.service import TemporalGraph
+from raphtory_tpu.ingestion.pipeline import IngestionPipeline
+from raphtory_tpu.ingestion.source import IterableSource
+from raphtory_tpu.ingestion.updates import EdgeAdd
+from raphtory_tpu.jobs import registry
+from raphtory_tpu.jobs.manager import AnalysisManager, LiveQuery, RangeQuery
+from raphtory_tpu.jobs.sink import ResultSink, resolve_sink_path
+
+
+def _graph(n=200):
+    pipe = IngestionPipeline()
+    rng = np.random.default_rng(0)
+    updates = [
+        EdgeAdd(int(t), int(a), int(b))
+        for t, a, b in zip(
+            np.sort(rng.integers(0, 100, n)),
+            rng.integers(0, 30, n),
+            rng.integers(0, 30, n),
+        )
+    ]
+    pipe.add_source(IterableSource(updates, name="test"))
+    pipe.run()
+    return TemporalGraph(pipe.log, pipe.watermarks)
+
+
+def test_range_job_writes_jsonl(tmp_path):
+    g = _graph()
+    mgr = AnalysisManager(g, sink_dir=str(tmp_path))
+    q = RangeQuery(start=20, end=90, jump=35, window=50)
+    job = mgr.submit(registry.resolve("ConnectedComponents"), q)
+    assert job.wait(60) and job.status == "done", job.error
+    path = tmp_path / f"{job.id}.jsonl"
+    assert path.exists()
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(rows) == len(job.results) == 3
+    # disk rows match the in-memory REST rows field for field
+    for disk, mem in zip(rows, job.results):
+        assert disk["time"] == mem["time"]
+        assert disk["windowsize"] == mem["windowsize"]
+        assert disk["steps"] == mem["steps"]
+        assert disk["result"] == json.loads(json.dumps(mem["result"],
+                                                       default=str))
+
+
+def test_csv_sink_format(tmp_path):
+    g = _graph()
+    mgr = AnalysisManager(g, sink_dir=str(tmp_path), sink_format="csv")
+    q = RangeQuery(start=50, end=90, jump=40)
+    job = mgr.submit(registry.resolve("PageRank", {"max_steps": 5}), q)
+    assert job.wait(60) and job.status == "done", job.error
+    path = tmp_path / f"{job.id}.csv"
+    with open(path, newline="") as fh:
+        rows = list(csv.DictReader(fh))
+    assert len(rows) == 2
+    assert rows[0]["time"] == "50" and rows[1]["time"] == "90"
+    for r in rows:
+        assert np.isfinite(json.loads(r["result"])["sum"])
+
+
+def test_kill_flushes_partial_output(tmp_path):
+    """A killed Live job's already-emitted rows are on disk and the file is
+    closed (the flush-on-kill contract)."""
+    g = _graph()
+    mgr = AnalysisManager(g, sink_dir=str(tmp_path))
+    job = mgr.submit(registry.resolve("DegreeBasic"), LiveQuery(repeat=0.05))
+    deadline = time.monotonic() + 20
+    while not job.results and time.monotonic() < deadline:
+        time.sleep(0.05)
+    mgr.kill(job.id)
+    assert job.wait(10) and job.status == "killed"
+    rows = [json.loads(line)
+            for line in (tmp_path / f"{job.id}.jsonl").read_text().splitlines()]
+    assert len(rows) == len(job.results) >= 1
+    assert job.sink._fh is None   # closed in the job's finally
+
+
+def test_requested_name_and_escape_rejection(tmp_path):
+    assert resolve_sink_path("", "j0") is None   # sinks disabled
+    p = resolve_sink_path(str(tmp_path), "j0", requested="sub/out.csv")
+    assert p == str(tmp_path / "sub" / "out.csv")
+    with pytest.raises(ValueError):
+        resolve_sink_path(str(tmp_path), "j0", requested="../evil.jsonl")
+    with pytest.raises(ValueError):
+        resolve_sink_path(str(tmp_path), "j0", requested="/abs/evil.jsonl")
+    # the job id is caller-supplied over REST too — same jail
+    with pytest.raises(ValueError):
+        resolve_sink_path(str(tmp_path), "../evil")
+    (tmp_path / "d.csv").mkdir()
+    with pytest.raises(ValueError):   # a directory is not a sink
+        resolve_sink_path(str(tmp_path), "j0", requested="d.csv")
+    # extensionless requested names take the asked-for format
+    p = resolve_sink_path(str(tmp_path), "j0", requested="out", fmt="csv")
+    assert p.endswith("out.csv")
+    with pytest.raises(ValueError):
+        resolve_sink_path(str(tmp_path), "j0", fmt="parquet")
+
+
+def test_live_jobs_cannot_share_a_sink_path(tmp_path):
+    g = _graph()
+    mgr = AnalysisManager(g, sink_dir=str(tmp_path))
+    j1 = mgr.submit(registry.resolve("DegreeBasic"), LiveQuery(repeat=0.05),
+                    sink_name="shared.jsonl")
+    try:
+        with pytest.raises(ValueError, match="in use"):
+            mgr.submit(registry.resolve("DegreeBasic"),
+                       LiveQuery(repeat=0.05), sink_name="shared.jsonl")
+        assert len(mgr.jobs()) == 1   # rejected submit rolled back
+    finally:
+        mgr.kill(j1.id)
+    assert j1.wait(10)
+    # once the first job finished, the path is appendable again
+    j2 = mgr.submit(registry.resolve("DegreeBasic"),
+                    LiveQuery(repeat=0.05, max_runs=1),
+                    sink_name="shared.jsonl")
+    assert j2.wait(20) and j2.status == "done", j2.error
+
+
+def test_symlink_cannot_escape_sink_dir(tmp_path):
+    jail = tmp_path / "jail"
+    outside = tmp_path / "outside"
+    jail.mkdir(), outside.mkdir()
+    (jail / "sub").symlink_to(outside)
+    with pytest.raises(ValueError):
+        resolve_sink_path(str(jail), "j0", requested="sub/x.jsonl")
+
+
+def test_sink_append_mode_keeps_csv_header_once(tmp_path):
+    path = str(tmp_path / "out.csv")
+    with ResultSink(path) as s:
+        s.write({"time": 1, "windowsize": None, "viewTime": 0.1,
+                 "steps": 2, "result": {"x": 1}})
+    with ResultSink(path) as s:   # re-open appends, no second header
+        s.write({"time": 2, "windowsize": None, "viewTime": 0.1,
+                 "steps": 2, "result": {"x": 2}})
+    with open(path, newline="") as fh:
+        rows = list(csv.DictReader(fh))
+    assert [r["time"] for r in rows] == ["1", "2"]

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Probe the tunnelled TPU every ~4 minutes; log state transitions.
+LOG=/tmp/tpu_probe.log
+echo "$(date -u +%H:%M:%S) probe loop start" >> $LOG
+while true; do
+  if timeout 90 /opt/venv/bin/python -c "import jax; d=jax.devices(); assert d and d[0].platform!='cpu', d; print(d)" >> $LOG 2>&1; then
+    echo "$(date -u +%H:%M:%S) TPU ALIVE" >> $LOG
+    touch /tmp/tpu_alive
+    exit 0
+  else
+    echo "$(date -u +%H:%M:%S) tpu down" >> $LOG
+  fi
+  sleep 240
+done
