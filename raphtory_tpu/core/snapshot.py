@@ -408,18 +408,20 @@ def _lex_lookup(sorted_keys: tuple, query_keys: tuple) -> np.ndarray:
     looked = _native.lex_lookup2(b1, b2, q1, q2)
     if looked is not None:
         return looked
-    lo = np.searchsorted(b1, q1, side="left")
-    hi = np.searchsorted(b1, q1, side="right")
-    out = np.full(len(q1), -1, np.int64)
-    # inner search vectorised via flattened offsets
-    for i in range(len(q1)):  # fallback loop; hot path replaced by native lib
-        l, h = lo[i], hi[i]
-        if l >= h:
-            continue
-        j = l + np.searchsorted(b2[l:h], q2[i])
-        if j < h and b2[j] == q2[i]:
-            out[i] = j
-    return out
+    # vectorised fallback: rank-encode both columns over the union of base
+    # and query values (ranks are order-preserving, so the packed base stays
+    # lex-sorted and never overflows the way raw ~2^62 ids would), then one
+    # searchsorted over the packed pairs
+    u2, inv2 = np.unique(np.concatenate([b2, q2]), return_inverse=True)
+    r_b2, r_q2 = inv2[:len(b2)], inv2[len(b2):]
+    u1, inv1 = np.unique(np.concatenate([b1, q1]), return_inverse=True)
+    r_b1, r_q1 = inv1[:len(b1)], inv1[len(b1):]
+    stride = np.int64(len(u2))
+    packed_b = r_b1.astype(np.int64) * stride + r_b2
+    packed_q = r_q1.astype(np.int64) * stride + r_q2
+    pos = np.searchsorted(packed_b, packed_q)
+    pos = np.clip(pos, 0, len(packed_b) - 1)
+    return np.where(packed_b[pos] == packed_q, pos, -1)
 
 
 def build_view(
