@@ -29,7 +29,19 @@ class NodeRuntime:
         self.scheduler = Scheduler()
         self.multi_host = bootstrap() if not self.settings.local else False
         self.topology = topology()
-        self.graph = TemporalGraph()
+        # restore-a-dead-shard: a replacement node with the same checkpoint
+        # dir rehydrates the log before serving (the reference designed this
+        # via Cassandra + SAVING, Utils.scala:22; here persist/checkpoint)
+        restored = None
+        if self.settings.checkpoint_dir:
+            import os
+
+            p = self.checkpoint_path()
+            if os.path.exists(p):
+                from ..persist.checkpoint import load_log
+
+                restored = load_log(p)
+        self.graph = TemporalGraph(restored)
         self.pipeline = IngestionPipeline(log=self.graph.log,
                                           watermarks=self.graph.watermarks)
         self.mesh = mesh
@@ -57,6 +69,10 @@ class NodeRuntime:
             self.scheduler.recurring(
                 "archivist", s.archivist_interval_s,
                 self.archivist.maybe_compact)
+        if s.saving and s.checkpoint_dir:
+            # the SAVING flag's durable-history cycle (Utils.scala:22)
+            self.scheduler.recurring(
+                "checkpoint", s.archivist_interval_s, self.checkpoint)
         if rest:
             from ..jobs.rest import RestServer
 
@@ -84,6 +100,21 @@ class NodeRuntime:
 
     def submit(self, program, query):
         return self.manager.submit(program, query)
+
+    def checkpoint_path(self) -> str:
+        import os
+
+        return os.path.join(self.settings.checkpoint_dir, "node.npz")
+
+    def checkpoint(self) -> None:
+        """Durable snapshot of the node's log (atomic tmp+rename; safe
+        during live ingestion — save_log freezes first)."""
+        import os
+
+        from ..persist.checkpoint import save_log
+
+        os.makedirs(self.settings.checkpoint_dir, exist_ok=True)
+        save_log(self.graph.log, self.checkpoint_path())
 
     def stop(self) -> None:
         self.pipeline.stop()

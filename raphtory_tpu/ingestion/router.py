@@ -1,0 +1,221 @@
+"""Elastic shard routing — growth re-hash + dead-shard buffering/restore.
+
+The reference's RouterWorker hashes every update's entity id to partition
+``hash % count`` and, when the WatchDog republishes a larger partition
+count, FUTURE updates re-hash across the grown set while history stays
+where it landed (``RouterManager.scala:86-100``,
+``Writer.scala:124-138`` ``UpdatedCounter``). Death is handled by the
+persistent store + Akka redelivery: a writer that comes back reloads its
+history and the spout's cluster gate keeps updates from vanishing.
+
+TPU-native re-design: shards here are event-log columns, not actors. The
+router slices each *batch* by a stable entity hash (vectorised — one
+``np.argsort`` per batch, not an actor hop per update) and appends every
+slice to its shard's ``EventLog``. A dead shard's slices are buffered in
+arrival order and replayed on rejoin, so nothing is lost between a crash
+and a checkpoint restore; a growth event atomically widens the modulus for
+future batches only. Analysis merges shard logs with a deterministic
+global sort (``merge_logs``) — equality with a never-failed run is the
+correctness contract (and the test).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..core.events import EventLog
+
+__all__ = ["Shard", "ShardDownError", "ShardRouter", "merge_logs"]
+
+
+class ShardDownError(RuntimeError):
+    """Raised by a shard that has crashed (its in-memory log is gone)."""
+
+
+class Shard:
+    """One ingestion shard: an event log + liveness + checkpoint hooks.
+
+    ``kill()`` models process death — the live log is dropped, so a later
+    ``restore()`` genuinely rebuilds from the last durable checkpoint
+    (persist/checkpoint.py), not from hidden host state."""
+
+    def __init__(self, shard_id: int, log: EventLog | None = None):
+        self.id = shard_id
+        self.log: EventLog | None = log if log is not None else EventLog()
+        self._lock = threading.Lock()
+
+    @property
+    def alive(self) -> bool:
+        return self.log is not None
+
+    def append_batch(self, t, k, s, d, props=None) -> None:
+        with self._lock:
+            if self.log is None:
+                raise ShardDownError(f"shard {self.id} is down")
+            self.log.append_batch(t, k, s, d, props=props)
+
+    def checkpoint(self, path: str) -> None:
+        from ..persist.checkpoint import save_log
+
+        with self._lock:
+            if self.log is None:
+                raise ShardDownError(f"shard {self.id} is down")
+            save_log(self.log, path)
+
+    def kill(self) -> None:
+        with self._lock:
+            self.log = None
+
+    def restore(self, path: str) -> None:
+        from ..persist.checkpoint import load_log
+
+        with self._lock:
+            self.log = load_log(path)
+
+
+class ShardRouter:
+    """Route update batches across an elastic shard set.
+
+    - Stable placement: every event of an entity keys on ``src`` (an edge
+      lives with its source vertex, the reference's edge-split rule), so a
+      shard holds a consistent slice of history.
+    - Growth: ``add_shard`` (or a WatchDog ``watch_counts`` subscription
+      via ``attach``) widens the modulus for future batches only.
+    - Death: slices bound for a dead shard queue in arrival order and
+      replay on ``revive`` — the at-least-once redelivery analogue, so a
+      kill→restore cycle loses nothing past the last checkpoint.
+    """
+
+    def __init__(self, shards: list[Shard] | int = 2):
+        if isinstance(shards, int):
+            shards = [Shard(i) for i in range(shards)]
+        if not shards:
+            raise ValueError("need at least one shard")
+        self.shards: list[Shard] = list(shards)
+        self._pending: dict[int, list[tuple]] = {}  # shard id → queued slices
+        self._lock = threading.Lock()
+
+    # ---- elasticity ----
+
+    def add_shard(self, shard: Shard | None = None) -> Shard:
+        """Grow the set; future updates hash over the wider modulus
+        (UpdatedCounter semantics: history does not move)."""
+        with self._lock:
+            if shard is None:
+                shard = Shard(len(self.shards))
+            self.shards.append(shard)
+        return shard
+
+    def attach(self, watchdog) -> None:
+        """Subscribe to the WatchDog's component-count republish: each
+        'shard' growth event adds one routing target (the RouterManager's
+        ``UpdatedCounter`` handler)."""
+
+        def on_count(role: str, count: int) -> None:
+            if role != "shard":
+                return
+            with self._lock:
+                need = count - len(self.shards)
+            for _ in range(need):
+                self.add_shard()
+
+        watchdog.watch_counts(on_count)
+
+    # ---- routing ----
+
+    def append_batch(self, t, k, s, d, props=None) -> None:
+        """Slice one batch across the current shard set (vectorised) and
+        deliver; slices for dead shards are queued for redelivery."""
+        t = np.asarray(t, np.int64)
+        k = np.asarray(k, np.uint8)
+        s = np.asarray(s, np.int64)
+        d = np.asarray(d, np.int64)
+        if len(t) == 0:
+            return
+        with self._lock:
+            targets = list(self.shards)   # modulus frozen per batch
+        n = len(targets)
+        owner = (s % n + n) % n            # ids can be negative (hashes)
+        prop_by_off = dict(props) if props else {}
+        for sid in np.unique(owner):
+            m = owner == sid
+            rows = np.flatnonzero(m)
+            sl_props = None
+            if prop_by_off:
+                remap = {int(r): i for i, r in enumerate(rows)}
+                sl_props = [(remap[off], p) for off, p in prop_by_off.items()
+                            if off in remap] or None
+            self._deliver(targets[int(sid)],
+                          (t[m], k[m], s[m], d[m], sl_props))
+
+    def _deliver(self, shard: Shard, sl: tuple) -> None:
+        try:
+            self._drain(shard)             # keep arrival order on rejoin
+            shard.append_batch(*sl)
+        except ShardDownError:
+            with self._lock:
+                self._pending.setdefault(shard.id, []).append(sl)
+
+    def _drain(self, shard: Shard) -> None:
+        with self._lock:
+            queued = self._pending.pop(shard.id, [])
+        try:
+            for i, sl in enumerate(queued):
+                shard.append_batch(*sl)
+        except ShardDownError:
+            with self._lock:   # died again mid-drain: requeue the tail
+                self._pending[shard.id] = (queued[i:]
+                                           + self._pending.get(shard.id, []))
+            raise
+
+    def revive(self, shard: Shard) -> None:
+        """Deliver everything queued while the shard was down (call after
+        ``Shard.restore``)."""
+        self._drain(shard)
+
+    def pending_events(self, shard_id: int | None = None) -> int:
+        """Queued (undelivered) event count — the dead-letter gauge."""
+        with self._lock:
+            items = (self._pending.get(shard_id, []) if shard_id is not None
+                     else [sl for q in self._pending.values() for sl in q])
+            return sum(len(sl[0]) for sl in items)
+
+
+def merge_logs(logs: list[EventLog]) -> EventLog:
+    """Deterministic union of shard logs for analysis: one global log
+    sorted by (time, kind, src, dst) — stable across which shard held
+    which slice, so a failure/restore run folds to the SAME graph as a
+    never-failed run. Property rows ride along with their events."""
+    cols = [(lg.column("time"), lg.column("kind"),
+             lg.column("src"), lg.column("dst"), lg) for lg in logs]
+    t = np.concatenate([c[0] for c in cols]) if cols else np.empty(0, np.int64)
+    k = np.concatenate([c[1] for c in cols]) if cols else np.empty(0, np.uint8)
+    s = np.concatenate([c[2] for c in cols]) if cols else np.empty(0, np.int64)
+    d = np.concatenate([c[3] for c in cols]) if cols else np.empty(0, np.int64)
+    order = np.lexsort((d, s, k, t))
+    merged = EventLog()
+    # gather property rows keyed by ORIGINAL (log, event row) before the sort
+    props_at: dict[int, dict] = {}
+    base = 0
+    for lg in logs:
+        pr = lg.props
+        ev_col = pr.column("event")
+        for j in range(len(ev_col)):
+            row = base + int(ev_col[j])
+            kid = int(pr.column("key")[j])
+            name = pr.key_name(kid)
+            if pr.is_immutable(kid):
+                name = "!" + name   # keep the immutability mark (events.py)
+            tag = int(pr.column("tag")[j])
+            val = (pr.string(int(pr.column("sref")[j])) if tag == 1
+                   else float(pr.column("num")[j]))
+            props_at.setdefault(row, {})[name] = val
+        base += lg.n
+    inv = np.empty(len(order), np.int64)
+    inv[order] = np.arange(len(order))
+    batch_props = [(int(inv[row]), p) for row, p in props_at.items()] or None
+    merged.append_batch(t[order], k[order], s[order], d[order],
+                        props=batch_props)
+    return merged

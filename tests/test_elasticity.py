@@ -1,0 +1,164 @@
+"""Elastic ingestion: growth re-hash, dead-shard buffering + restore
+(ref: RouterManager.scala:86-100 UpdatedCounter, Writer.scala:124-138;
+WatchDog.scala:116-124 grow-only ids)."""
+
+import numpy as np
+import pytest
+
+from raphtory_tpu.core import events as ev
+from raphtory_tpu.core.snapshot import build_view
+from raphtory_tpu.ingestion.router import (
+    Shard,
+    ShardDownError,
+    ShardRouter,
+    merge_logs,
+)
+
+
+def _batches(n_batches=20, per=64, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    t0 = 0
+    for _ in range(n_batches):
+        t = np.sort(rng.integers(t0, t0 + 50, per)).astype(np.int64)
+        k = np.where(rng.random(per) < 0.9, ev.EDGE_ADD,
+                     ev.EDGE_DELETE).astype(np.uint8)
+        s = rng.integers(0, 40, per).astype(np.int64)
+        d = rng.integers(0, 40, per).astype(np.int64)
+        out.append((t, k, s, d))
+        t0 += 25
+    return out
+
+
+def _view_sig(log, T):
+    v = build_view(log, T)
+    verts = sorted(int(x) for x in v.vids[v.v_mask])
+    edges = sorted(map(tuple, np.stack(
+        [v.vids[v.e_src[v.e_mask]], v.vids[v.e_dst[v.e_mask]]], 1).tolist()))
+    return verts, edges
+
+
+def test_kill_restore_equals_no_failure_run(tmp_path):
+    """Kill a shard mid-ingest, restore it from its checkpoint, replay the
+    buffered slices: the merged graph equals the never-failed run."""
+    batches = _batches()
+
+    # reference run: no failure
+    ref = ShardRouter(3)
+    for b in batches:
+        ref.append_batch(*b)
+    ref_merged = merge_logs([sh.log for sh in ref.shards])
+
+    # failure run: checkpoint shard 1, kill it mid-stream, restore, revive
+    rt = ShardRouter(3)
+    ckpt = str(tmp_path / "shard1.npz")
+    for i, b in enumerate(batches):
+        if i == 8:
+            rt.shards[1].checkpoint(ckpt)
+            rt.shards[1].kill()
+            assert not rt.shards[1].alive
+        if i == 15:
+            rt.shards[1].restore(ckpt)
+            rt.revive(rt.shards[1])
+            assert rt.pending_events(1) == 0
+        rt.append_batch(*b)
+    assert rt.pending_events() == 0
+    got_merged = merge_logs([sh.log for sh in rt.shards])
+
+    assert got_merged.n == ref_merged.n == sum(len(b[0]) for b in batches)
+    for T in (100, 300, 550):
+        assert _view_sig(got_merged, T) == _view_sig(ref_merged, T)
+
+
+def test_buffered_slices_preserve_arrival_order(tmp_path):
+    """Same-entity updates queued while a shard is down land in arrival
+    order on revive (delete-after-add must stay delete-after-add)."""
+    rt = ShardRouter(1)
+    ckpt = str(tmp_path / "s.npz")
+    rt.shards[0].checkpoint(ckpt)
+    rt.shards[0].kill()
+    rt.append_batch([10], [ev.EDGE_ADD], [5], [6])
+    rt.append_batch([10], [ev.EDGE_DELETE], [5], [6])
+    assert rt.pending_events() == 2
+    rt.shards[0].restore(ckpt)
+    rt.revive(rt.shards[0])
+    log = rt.shards[0].log
+    assert list(log.column("kind")) == [ev.EDGE_ADD, ev.EDGE_DELETE]
+    # delete-wins at the tie: the edge is gone
+    _, edges = _view_sig(log, 10)
+    assert edges == []
+
+
+def test_growth_rehashes_future_updates_only():
+    rt = ShardRouter(2)
+    rt.append_batch([1, 1], [ev.EDGE_ADD] * 2, [0, 1], [9, 9])
+    before = [sh.log.n for sh in rt.shards]
+    rt.add_shard()
+    # src=2 now hashes 2 % 3 == 2: the NEW shard takes future updates
+    rt.append_batch([2, 2, 2], [ev.EDGE_ADD] * 3, [0, 1, 2], [9, 9, 9])
+    after = [sh.log.n for sh in rt.shards]
+    assert len(after) == 3 and after[2] == 1
+    # history did not move
+    assert after[0] >= before[0] and after[1] >= before[1]
+
+
+def test_watchdog_growth_feeds_router():
+    """A new shard joining the WatchDog widens the router's modulus — the
+    PartitionsCount republish consumed end-to-end."""
+    from raphtory_tpu.cluster.watchdog import WatchDog
+
+    wd = WatchDog()
+    rt = ShardRouter(1)
+    rt.attach(wd)
+    wd.join("shard")   # count 1 → no growth (router already has 1)
+    assert len(rt.shards) == 1
+    wd.join("shard")   # count 2 → grow
+    wd.join("shard")   # count 3 → grow
+    assert len(rt.shards) == 3
+    rt.append_batch([1, 1, 1], [ev.EDGE_ADD] * 3, [0, 1, 2], [9, 9, 9])
+    assert [sh.log.n for sh in rt.shards] == [1, 1, 1]
+
+
+def test_dead_shard_raises_and_buffers_props():
+    rt = ShardRouter(2)
+    rt.shards[0].kill()
+    with pytest.raises(ShardDownError):
+        rt.shards[0].append_batch([1], [ev.EDGE_ADD], [0], [1])
+    # routed WITH props: offsets remap into each shard's slice
+    rt.append_batch([5, 5], [ev.EDGE_ADD] * 2, [0, 1], [7, 8],
+                    props=[(0, {"w": 2.5}), (1, {"name": "x"})])
+    assert rt.pending_events(0) == 1
+    # shard 1 (alive) got its slice including the string prop
+    lg = rt.shards[1].log
+    assert lg.n == 1 and lg.props.n == 1
+    assert lg.props.string(0) == "x"
+
+
+def test_merge_logs_carries_props_and_immutability():
+    a, b = ShardRouter(2).shards
+    a.log.add_edge(1, 0, 2, props={"w": 1.5, "!kind": "road"})
+    b.log.add_edge(1, 1, 3, props={"w": 2.5})
+    merged = merge_logs([a.log, b.log])
+    assert merged.n == 2
+    pr = merged.props
+    assert pr.n == 3
+    assert pr.is_immutable(pr.key_id("kind"))
+    assert not pr.is_immutable(pr.key_id("w"))
+
+
+def test_node_runtime_restores_from_checkpoint(tmp_path):
+    from raphtory_tpu.cluster.runtime import NodeRuntime
+    from raphtory_tpu.utils.config import Settings
+
+    s = Settings(checkpoint_dir=str(tmp_path), saving=True,
+                 archiving=False, compressing=False)
+    node = NodeRuntime(settings=s)
+    node.graph.log.add_edge(5, 1, 2)
+    node.graph.log.add_edge(7, 2, 3)
+    node.checkpoint()
+    node.stop()
+
+    node2 = NodeRuntime(settings=s)   # the replacement node
+    assert node2.graph.log.n == 2
+    assert _view_sig(node2.graph.log, 10) == _view_sig(node.graph.log, 10)
+    node2.stop()
