@@ -27,7 +27,7 @@ from .watermark import WatermarkRegistry
 class IngestionPipeline:
     def __init__(self, log: EventLog | None = None,
                  watermarks: WatermarkRegistry | None = None,
-                 batch_size: int = 4096):
+                 batch_size: int = 4096, queue_max_events: int = 0):
         self.log = log if log is not None else EventLog()
         self.watermarks = watermarks if watermarks is not None else WatermarkRegistry()
         self.batch_size = batch_size
@@ -36,6 +36,28 @@ class IngestionPipeline:
         self._feeds: list[tuple[Source, Parser]] = []
         self.counts: dict[str, int] = {}
         self.errors: dict[str, str] = {}
+        # staged mode (queue_max_events > 0): parse and append run in
+        # separate threads with a BOUNDED event queue between them — the
+        # reference's writer-mailbox shape (SURVEY §4.5: queue depth was
+        # the paper's saturation oracle, WriterLogger.scala:21-30). A full
+        # queue blocks the source (backpressure), so memory stays bounded
+        # and a pinned-at-max backlog gauge IS the saturation signal.
+        self.queue_max_events = queue_max_events
+        self._q: list = []
+        self._q_events = 0
+        self._q_cv = threading.Condition()
+        self._q_done = False
+        self._writer: threading.Thread | None = None
+        self._failed: set[str] = set()   # sources whose writer append died
+
+    @property
+    def staged(self) -> bool:
+        return self.queue_max_events > 0
+
+    def backlog(self) -> int:
+        """Parsed-but-unappended event count (0 in direct mode)."""
+        with self._q_cv:
+            return self._q_events
 
     def add_source(self, source: Source, parser: Parser | None = None) -> None:
         if source.name in self.counts:
@@ -51,12 +73,15 @@ class IngestionPipeline:
 
     def run(self) -> None:
         """Drain every source to exhaustion on the calling thread."""
+        self._ensure_writer()
         for source, parser in self._feeds:
             self._consume(source, parser)
+        self._finish_writer()
 
     # ---- live mode (threads; SpoutTrait self-scheduling analogue) ----
 
     def start(self) -> None:
+        self._ensure_writer()
         for source, parser in self._feeds:
             t = threading.Thread(
                 target=self._consume, args=(source, parser),
@@ -69,10 +94,108 @@ class IngestionPipeline:
         for t in self._threads:
             t.join(timeout)
         self._threads.clear()
+        self._finish_writer(timeout)
 
     def join(self, timeout: float | None = None) -> None:
         for t in self._threads:
             t.join(timeout)
+        self._finish_writer(timeout)
+
+    # ---- staged-mode writer (bounded mailbox between parse and append) ----
+
+    def _ensure_writer(self) -> None:
+        if not self.staged or (self._writer is not None
+                               and self._writer.is_alive()):
+            return
+        self._q_done = False
+        self._writer = threading.Thread(
+            target=self._writer_loop, name="ingest-writer", daemon=True)
+        self._writer.start()
+
+    def _finish_writer(self, timeout: float | None = None) -> None:
+        if self._writer is None:
+            return
+        with self._q_cv:
+            self._q_done = True
+            self._q_cv.notify_all()
+        self._writer.join(timeout)
+        if not self._writer.is_alive():   # a timed-out join keeps the ref,
+            self._writer = None           # so no second writer can spawn
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._q_cv:
+                while not self._q and not self._q_done:
+                    self._q_cv.wait(0.1)
+                if not self._q:
+                    return
+                kind, name, payload, wm = self._q.pop(0)
+                if kind == "batch":
+                    self._q_events -= len(payload[0])
+                    METRICS.ingest_backlog.set(self._q_events)
+                    self._q_cv.notify_all()   # unblock backpressured sources
+            try:
+                if kind == "batch":
+                    if name in self._failed:
+                        continue   # poisoned: no appends, no wm advance
+                    t, k, s, d, props = payload
+                    if len(t):
+                        self.log.append_batch(t, k, s, d, props=props)
+                        METRICS.log_events.set(self.log.n)
+                    if wm is not None:
+                        self.watermarks.advance(name, wm)
+                else:   # "finish": released only once the source's batches
+                    self.watermarks.finish(name)   # all landed (FIFO)
+            except Exception as e:  # noqa: BLE001 — surfaced via errors
+                import traceback
+
+                # poison the source: later batches must not land past the
+                # hole (the fence would claim completeness over missing
+                # events) — matching direct mode, where the exception kills
+                # the consume loop. The "finish" marker still releases the
+                # fence, exactly like _consume's finally.
+                self._failed.add(name)
+                self.errors.setdefault(name, (
+                    f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+    def _sink_batch(self, name: str, t, k, s, d, props=None,
+                    wm: int | None = None) -> None:
+        """Deliver one parsed batch to the log: directly (default), or via
+        the bounded queue (staged). The watermark advance rides WITH the
+        batch so safe_time never overtakes events still in the queue."""
+        if not self.staged:
+            if len(t):
+                self.log.append_batch(t, k, s, d, props=props)
+                METRICS.log_events.set(self.log.n)
+            if wm is not None:
+                self.watermarks.advance(name, wm)
+            return
+        if name in self._failed:
+            # mirror direct mode, where the append exception killed this
+            # source's consume loop: re-raise the writer's failure into it
+            raise RuntimeError(f"ingest writer failed for source {name!r} "
+                               f"(see pipeline.errors)")
+        with self._q_cv:
+            if self._q_done:
+                return   # writer retired (post-stop zombie source): drop
+            while (self._q_events + len(t) > self.queue_max_events
+                   and self._q_events > 0 and not self._stop.is_set()):
+                self._q_cv.wait(0.1)   # backpressure: block, don't grow
+            self._q.append(("batch", name, (t, k, s, d, props), wm))
+            self._q_events += len(t)
+            METRICS.ingest_backlog.set(self._q_events)
+            self._q_cv.notify_all()
+
+    def _sink_finish(self, name: str) -> None:
+        if not self.staged:
+            self.watermarks.finish(name)
+            return
+        with self._q_cv:
+            if self._q_done:   # writer retired: release the fence directly
+                self.watermarks.finish(name)
+                return
+            self._q.append(("finish", name, None, None))
+            self._q_cv.notify_all()
 
     # ---- internals ----
 
@@ -82,14 +205,17 @@ class IngestionPipeline:
         except Exception as e:  # noqa: BLE001 — surfaced via self.errors
             import traceback
 
-            self.errors[source.name] = (
-                f"{type(e).__name__}: {e}\n{traceback.format_exc()}")
+            # setdefault: if the staged writer already recorded the root
+            # cause, the re-raised poison marker must not mask it
+            self.errors.setdefault(source.name, (
+                f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
             METRICS.parse_errors.labels(source.name).inc()
         finally:
             # A dead source will never append again — releasing the fence is
             # correct AND required, or one bad line would wedge safe_time()
             # forever while the failure sat invisible in a daemon thread.
-            self.watermarks.finish(source.name)
+            # (Staged: the release queues BEHIND the source's last batch.)
+            self._sink_finish(source.name)
 
     def _consume_inner(self, source: Source, parser: Parser) -> None:
         if self._consume_bulk(source, parser):
@@ -99,16 +225,16 @@ class IngestionPipeline:
         max_t = -(2**62)
         n = 0
 
-        def flush():
+        def flush(wm: int | None = None):
             nonlocal bt, bk, bs, bd, pending_props
-            if not bt:
+            if not bt and wm is None:
                 return
             METRICS.events_ingested.labels(source.name).inc(len(bt))
-            self.log.append_batch(
+            self._sink_batch(
+                source.name,
                 np.asarray(bt, np.int64), np.asarray(bk, np.uint8),
                 np.asarray(bs, np.int64), np.asarray(bd, np.int64),
-                props=pending_props)
-            METRICS.log_events.set(self.log.n)
+                props=pending_props or None, wm=wm)
             bt, bk, bs, bd, pending_props = [], [], [], [], []
 
         dropped_ctr = METRICS.records_dropped.labels(source.name)
@@ -141,17 +267,14 @@ class IngestionPipeline:
                 max_t = max(max_t, u.time)
                 n += 1
             if len(bt) >= self.batch_size:
-                flush()
                 # -1: a later tuple may still arrive at exactly
                 # max_t - disorder (equal timestamps are legal), so the
                 # promise "no event <= w will ever be appended" needs the
                 # strict bound
-                self.watermarks.advance(
-                    source.name, max_t - source.disorder - 1)
-        flush()
+                flush(wm=max_t - source.disorder - 1)
+        flush(wm=(max_t - source.disorder - 1)
+              if max_t > -(2**62) else None)
         self.counts[source.name] = n
-        if max_t > -(2**62):
-            self.watermarks.advance(source.name, max_t - source.disorder - 1)
 
     def _consume_bulk(self, source: Source, parser: Parser) -> bool:
         """Native fast path: source exposes a byte buffer and the parser a
@@ -167,10 +290,8 @@ class IngestionPipeline:
             return False
         t, k, s, d = out
         if len(t):
-            self.log.append_batch(t, k, s, d)
-            self.watermarks.advance(
-                source.name, int(t.max()) - source.disorder - 1)
             METRICS.events_ingested.labels(source.name).inc(int(len(t)))
-            METRICS.log_events.set(self.log.n)
+            self._sink_batch(source.name, t, k, s, d,
+                             wm=int(t.max()) - source.disorder - 1)
         self.counts[source.name] = int(len(t))
         return True

@@ -49,6 +49,11 @@ class Metrics:
         self.watermark = Gauge(
             "raphtory_watermark_safe_time",
             "Safe event time promised by all live sources", registry=r)
+        self.ingest_backlog = Gauge(
+            "raphtory_ingest_backlog_events",
+            "Events parsed but not yet appended to the log (bounded-"
+            "mailbox depth; the WriterLogger queue-size analogue)",
+            registry=r)
         # storage (WriterLogger gauges)
         self.log_events = Gauge(
             "raphtory_log_events", "Rows in the event log", registry=r)

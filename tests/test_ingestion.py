@@ -198,3 +198,109 @@ def test_watermark_wait_for_wakes_on_advance():
     wm2.finish("x")
     th2.join(1.0)
     assert not th2.is_alive()
+
+
+def test_staged_pipeline_matches_direct_mode():
+    """queue_max_events>0 routes parse → bounded queue → writer thread;
+    the resulting log equals direct mode's and the backlog drains to 0."""
+    def updates():
+        rng = np.random.default_rng(3)
+        return [EdgeAdd(int(t), int(a), int(b))
+                for t, a, b in zip(np.sort(rng.integers(0, 500, 3000)),
+                                   rng.integers(0, 50, 3000),
+                                   rng.integers(0, 50, 3000))]
+
+    direct = IngestionPipeline(batch_size=128)
+    direct.add_source(IterableSource(updates(), name="s"))
+    direct.run()
+
+    staged = IngestionPipeline(batch_size=128, queue_max_events=512)
+    staged.add_source(IterableSource(updates(), name="s"))
+    staged.run()
+
+    assert not staged.errors and not direct.errors
+    assert staged.backlog() == 0
+    assert staged.log.n == direct.log.n == 3000
+    for col in ("time", "kind", "src", "dst"):
+        np.testing.assert_array_equal(staged.log.column(col),
+                                      direct.log.column(col))
+    # both fences fully released
+    assert staged.watermarks.safe_time() == direct.watermarks.safe_time()
+
+
+def test_staged_watermark_never_overtakes_queue():
+    """safe_time must lag events still sitting in the queue: the advance
+    rides the batch through the writer, so a view at the watermark always
+    sees every event the fence promises."""
+    import threading
+    import time as _t
+
+    gate = threading.Event()
+    n = 600
+
+    class GatedIterable:
+        def __iter__(self):
+            for i in range(n):
+                if i == 300:
+                    gate.wait(10)   # stall mid-stream with queue part-full
+                yield EdgeAdd(i, i % 20, (i + 1) % 20)
+
+    pipe = IngestionPipeline(batch_size=64, queue_max_events=100_000)
+    src = IterableSource(GatedIterable(), name="gated")
+    pipe.add_source(src)
+
+    # slow the writer so batches pile up in the queue
+    orig_append = pipe.log.append_batch
+
+    def slow_append(*a, **k):
+        _t.sleep(0.02)
+        return orig_append(*a, **k)
+
+    pipe.log.append_batch = slow_append
+    pipe.start()
+    deadline = _t.monotonic() + 10
+    while pipe.backlog() == 0 and _t.monotonic() < deadline:
+        _t.sleep(0.005)
+    # invariant while the queue is non-empty: every event <= safe_time is
+    # already IN the log (count events in log with time <= w)
+    for _ in range(50):
+        w = pipe.watermarks.safe_time()
+        n_log = pipe.log.n
+        if w >= 0 and w < 2**62:
+            times = pipe.log.column("time")[:n_log]
+            assert (times <= w).sum() == (w + 1), (w, n_log)
+        _t.sleep(0.002)
+    gate.set()
+    pipe.join(20)
+    assert pipe.backlog() == 0 and pipe.log.n == n
+
+
+def test_staged_writer_failure_poisons_source():
+    """An append failure in the staged writer stops the source (no events
+    land past the hole), surfaces the ROOT cause, and still releases the
+    watermark fence — matching direct mode's failure semantics."""
+    boom = {"armed": False}
+
+    pipe = IngestionPipeline(batch_size=32, queue_max_events=4096)
+    orig_append = pipe.log.append_batch
+
+    def flaky_append(*a, **k):
+        if boom["armed"]:
+            raise MemoryError("injected append failure")
+        return orig_append(*a, **k)
+
+    pipe.log.append_batch = flaky_append
+
+    def stream():
+        for i in range(2000):
+            if i == 500:
+                boom["armed"] = True
+            yield EdgeAdd(i, i % 20, (i + 1) % 20)
+
+    pipe.add_source(IterableSource(stream(), name="s"))
+    pipe.run()
+    assert "MemoryError" in pipe.errors["s"]          # root cause, not the
+    assert "injected append failure" in pipe.errors["s"]  # poison marker
+    assert pipe.log.n <= 512                           # nothing past the hole
+    assert pipe.watermarks.safe_time() >= 2**62        # fence released
+    assert pipe.backlog() == 0 or pipe._q_done
