@@ -579,6 +579,86 @@ def bench_ingest():
     }
 
 
+def bench_ingest_sustained():
+    """The paper's §6.1 ramp protocol, with the backlog gauge as the
+    failure oracle (the dead-letter/queue monitoring analogue,
+    WriterLogger.scala:21-30): offered rate ramps +step every interval
+    through a staged pipeline (parse → bounded queue → writer); the max
+    SUSTAINABLE throughput is the highest interval where the backlog
+    stayed bounded and achieved kept up with offered — not a burst
+    number."""
+    from raphtory_tpu.core.service import TemporalGraph
+    from raphtory_tpu.ingestion.parser import IdentityParser
+    from raphtory_tpu.ingestion.pipeline import IngestionPipeline
+    from raphtory_tpu.ingestion.source import RandomSource, RateLimited
+
+    queue_max = 200_000
+    r0, step, interval = 50_000.0, 50_000.0, 1.0
+    n_events = 8_000_000   # enough stream to outlast the ramp
+    src = RateLimited(RandomSource(n_events, id_pool=1_000_000, seed=1),
+                      rate=r0, ramp_step=step, ramp_interval_s=interval)
+    g = TemporalGraph()
+    pipe = IngestionPipeline(g.log, watermarks=g.watermarks,
+                             queue_max_events=queue_max)
+    pipe.add_source(src, IdentityParser())
+    pipe.start()
+    samples = []
+    t0 = _time.perf_counter()
+    last_n, last_t = 0, 0.0
+    saturated = False
+    while True:
+        _time.sleep(interval)
+        now = _time.perf_counter() - t0
+        n = g.log.n
+        backlog = pipe.backlog()
+        # the rate in effect during the interval just MEASURED (it started
+        # at last_t), not the next interval's ramped-up value
+        offered = r0 + step * int(last_t / interval)
+        achieved = (n - last_n) / (now - last_t)
+        samples.append({"t": round(now, 2), "offered": offered,
+                        "achieved": round(achieved, 1),
+                        "backlog": int(backlog)})
+        last_n, last_t = n, now
+        # oracle: a backlog pinned near the bound means the writer lost
+        # the race — the offered rate is past sustainable
+        if backlog >= 0.8 * queue_max:
+            saturated = True
+            break
+        # capacity passed: offered has outrun achieved for 3 straight
+        # intervals (either the queue pins — writer-bound — or the parse
+        # stage itself is the limit and can't even fill the queue)
+        if len(samples) >= 3 and all(
+                s["offered"] > 1.5 * s["achieved"] for s in samples[-3:]):
+            saturated = True
+            break
+        if n >= n_events or now > 45.0:
+            break
+    pipe.stop(timeout=30.0)
+    if pipe.errors:
+        raise RuntimeError(f"ingest errors: {pipe.errors}")
+    ok = [s for s in samples
+          if s["backlog"] < 0.5 * queue_max
+          and s["achieved"] >= 0.9 * s["offered"]]
+    sustained = max((s["achieved"] for s in ok), default=0.0)
+    return {
+        "metric": ("max sustainable ingest throughput (ramp protocol, "
+                   "backlog oracle)"),
+        "value": round(sustained, 1),
+        "unit": "updates/sec",
+        "vs_baseline": round(sustained / REF_INGEST_1PM, 2),
+        "detail": {
+            "saturated": saturated,
+            "ramp": f"{r0:.0f} +{step:.0f}/{interval:.0f}s",
+            "queue_max_events": queue_max,
+            "oracle": "backlog < 50% bound and achieved >= 90% offered",
+            "samples": samples[-12:],
+            "baseline": "paper §6.1: 27k updates/s sustained (1 PM), "
+                        "ramp +1k msgs/s per minute",
+            "vs_8pm": round(sustained / REF_INGEST_8PM, 2),
+        },
+    }
+
+
 # v5e-class single-chip peaks for utilisation reporting (scale configs)
 PEAK_HBM_GBPS = 819.0
 PEAK_BF16_TFLOPS = 197.0
@@ -740,7 +820,8 @@ def bench_scale_features():
                    f"{rounds} rounds)"),
         "value": round(vps, 3),
         "unit": "views/sec",
-        "vs_baseline": 0.0,   # no reference analogue exists
+        "vs_baseline": None,   # no reference analogue exists (not "0x" —
+        # detail.baseline carries the explanation)
         "detail": {
             "n_views": len(calls),
             "n_vertices": n_v,
@@ -767,6 +848,7 @@ CONFIGS = {
     "bitcoin_range": bench_bitcoin_range,
     "ldbc_traversal": bench_ldbc_traversal,
     "ingest": bench_ingest,
+    "ingest_sustained": bench_ingest_sustained,
     "scale_pagerank": bench_scale_pagerank,
     "scale_features": bench_scale_features,
 }
