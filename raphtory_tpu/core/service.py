@@ -33,6 +33,17 @@ class TemporalGraph:
         self._cache: collections.OrderedDict = collections.OrderedDict()
         self._cache_size = cache_size
         self._cache_lock = threading.Lock()  # jobs share one graph
+        # warm View engine: one resident DeviceSweep shared by View/Live
+        # dispatches (engine/device_sweep keeps fold state ON device) —
+        # a repeat view is a delta-advance + one dispatch, not a full
+        # host fold + O(m) upload (ReaderWorker.scala:293-352 rebuilds a
+        # lens per job; this is the thing that beats it)
+        self._resident = None
+        self._resident_lock = threading.Lock()
+        self._resident_version = -1
+        self._resident_n = 0            # rows scanned for post-pin events
+        self._post_pin_min = 2**62      # min event time appended after pin
+        self._resident_broken = False   # e.g. >2^31 vertices: stop retrying
 
     # ---- time bounds ----
 
@@ -95,6 +106,79 @@ class TemporalGraph:
             while len(self._cache) > self._cache_size:
                 self._cache.popitem(last=False)
 
+    def resident_acquire(self, time: int):
+        """Acquire the shared resident DeviceSweep for a warm View dispatch
+        at ``time``; returns ``(sweep, held_lock)`` — the caller MUST
+        release the lock — or None when the resident path cannot serve:
+
+        * ``time`` behind the sweep's clock (DeviceSweep only ascends; the
+          cold path's view cache handles out-of-order timestamps), or
+        * the log's id space overflows the packed-key engine.
+
+        A pin is replaced (not declined) when events appended after it
+        land at or before ``time`` — exact, via an incremental min over
+        the post-pin rows.
+
+        The caller is responsible for the watermark fence (only ask for
+        ``time`` ≤ ``safe_time()``)."""
+        if self._resident_broken:
+            return None
+        self._resident_lock.acquire()
+        try:
+            sweep = self._resident
+            if sweep is not None:
+                if self.log.version != self._resident_version:
+                    # the pinned fold can't see events appended after the
+                    # pin — an incremental min over the new rows tells
+                    # EXACTLY whether any lands at or before `time`
+                    # (watermarks alone can't: direct log appends are
+                    # legal and unfenced). pin() captures (n, version)
+                    # atomically, so rows landing after this scan bump the
+                    # live version past the one stored here.
+                    pinned = self.log.pin()
+                    if self._resident_n < pinned.n:
+                        tcol = pinned.column("time")
+                        self._post_pin_min = min(
+                            self._post_pin_min,
+                            int(tcol[self._resident_n:pinned.n].min()))
+                        self._resident_n = pinned.n
+                    self._resident_version = pinned.version
+                # checked on EVERY acquire, not only when the version just
+                # moved — an earlier small-time acquire may have recorded
+                # the post-pin min and synced the version already
+                if int(time) >= self._post_pin_min:
+                    sweep = None   # stale for this time: re-pin below
+            if sweep is None:
+                from ..engine.device_sweep import DeviceSweep
+
+                pinned = self.log.pin()   # (n, version) atomic with rows
+                sweep = DeviceSweep(pinned)
+                self._resident = sweep
+                self._resident_version = pinned.version
+                self._resident_n = pinned.n
+                self._post_pin_min = 2**62
+            if sweep.t_now is not None and int(time) < sweep.t_now:
+                self._resident_lock.release()
+                return None
+            return sweep, self._resident_lock
+        except ValueError:
+            self._resident_broken = True
+            self._resident_lock.release()
+            return None
+        except BaseException:
+            self._resident_lock.release()
+            raise
+
+    def resident_discard(self) -> None:
+        """Drop the resident sweep. Callers that hit device trouble
+        mid-dispatch MUST call this while still holding the acquired lock:
+        a partially applied delta leaves the device buffers inconsistent
+        with the host fold, and the next acquire must re-pin."""
+        self._resident = None
+        self._resident_version = -1
+        self._resident_n = 0
+        self._post_pin_min = 2**62
+
     # ---- maintenance ----
 
     def swap_log(self, new_log: EventLog) -> None:
@@ -107,6 +191,11 @@ class TemporalGraph:
     def invalidate_cache(self) -> None:
         with self._cache_lock:
             self._cache.clear()
+        with self._resident_lock:
+            self._resident = None   # a swapped log may reuse version ids
+            self._resident_version = -1
+            self._resident_n = 0
+            self._post_pin_min = 2**62
 
     def checkpoint(self, path: str) -> None:
         from ..persist.checkpoint import save_log

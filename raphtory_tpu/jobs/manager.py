@@ -187,6 +187,18 @@ class Job:
             else:
                 self._kill.wait(q.repeat)
 
+    def _device_engine_ok(self) -> bool:
+        """Shared eligibility gate for the device-resident engines (warm
+        View, single-device Range, mesh Range): the program must run
+        without occurrences/property joins (``device_sweep.supported``)
+        and its reduce must accept the vertex-side shell view."""
+        from ..engine.device_sweep import supported
+
+        if not supported(self.program):
+            return False
+        return (type(self.program).reduce is VertexProgram.reduce
+                or self.program.reduce_shell_safe)
+
     def _try_range_mesh(self, q: RangeQuery) -> bool:
         """Amortised mesh range sweep: one static partition for the whole
         range, per-hop O(delta) updates, hop i+1's host fold overlapped with
@@ -194,17 +206,10 @@ class Job:
         False when the query/program must use the per-hop path."""
         if self.mesh is None or self.graph.safe_time() < q.end:
             return False
-        from ..engine.device_sweep import supported
         from ..parallel import sharded as _sh
         from ..parallel.sweep import ShardedSweep
 
-        if not supported(self.program):
-            return False
-        # the shell view handed to reducers has no edge masks or property
-        # joins — only pass-through reducers or ones declared shell-safe
-        # (vertex-side fields only) may take this path
-        if (type(self.program).reduce is not VertexProgram.reduce
-                and not self.program.reduce_shell_safe):
+        if not self._device_engine_ok():
             return False
         try:
             sweep = ShardedSweep(self.graph.log,
@@ -404,12 +409,9 @@ class Job:
         O(delta) per-hop uploads, pipelined emit (engine/device_sweep)."""
         if self.mesh is not None or self.graph.safe_time() < q.end:
             return False
-        from ..engine.device_sweep import DeviceSweep, supported
+        from ..engine.device_sweep import DeviceSweep
 
-        if not supported(self.program):
-            return False
-        if (type(self.program).reduce is not VertexProgram.reduce
-                and not self.program.reduce_shell_safe):
+        if not self._device_engine_ok():
             return False
         try:
             sweep = DeviceSweep(self.graph.log)
@@ -466,7 +468,66 @@ class Job:
             result = jax.tree_util.tree_map(np.asarray, result)
             self._emit(t, q.window, result, rv, steps, t0)
 
+    def _try_view_resident(self, t: int, q) -> bool:
+        """Warm View/Live dispatch through the graph's shared resident
+        DeviceSweep: delta-advance + one compiled dispatch instead of a
+        full host fold + O(m) upload per request (the cold ``view_at``
+        path; ref builds a fresh lens per job, ReaderWorker.scala:293-352).
+        Returns False when the query/program must use the cold path."""
+        import jax
+        import numpy as np
+
+        p = self.program
+        if self.mesh is not None or self.graph.safe_time() < int(t):
+            return False   # the cold path owns the fence wait
+        if not self._device_engine_ok():
+            return False
+        try:
+            acq = self.graph.resident_acquire(int(t))
+        except Exception as e:
+            # device trouble building the one-time tables (e.g. a tunnel
+            # flap during the upload): the cold path must still serve
+            _jobs_log.warning("resident sweep build failed (%s: %s) — "
+                              "falling back to the cold path",
+                              type(e).__name__, e)
+            return False
+        if acq is None:
+            return False
+        sweep, lock = acq
+        t0 = _time.perf_counter()
+        try:
+            s0 = _time.perf_counter()
+            sweep.advance(int(t))
+            METRICS.snapshot_build_seconds.observe(_time.perf_counter() - s0)
+            windows = list(q.windows) if q.windows is not None else None
+            result, steps = sweep.run(p, window=q.window, windows=windows)
+            rv = _DeviceShell(sweep).freeze()
+            result = jax.tree_util.tree_map(np.asarray, result)  # block here
+            steps = int(steps)
+        except Exception as e:
+            # device trouble mid-dispatch: a partially applied delta (or a
+            # failed donated-buffer call) can leave the device state
+            # inconsistent with the host fold — drop the sweep while the
+            # lock is still held, then decline to the cold path
+            self.graph.resident_discard()
+            _jobs_log.warning("resident view route failed (%s: %s) — "
+                              "falling back to the cold path",
+                              type(e).__name__, e)
+            return False
+        finally:
+            lock.release()
+        METRICS.supersteps.inc(max(steps, 0))
+        if windows is not None:
+            for i, w in enumerate(windows):
+                r_i = jax.tree_util.tree_map(lambda a: a[i], result)
+                self._emit(t, w, r_i, rv, steps, t0)
+        else:
+            self._emit(t, q.window, result, rv, steps, t0)
+        return True
+
     def _run_at(self, t: int, q, exact: bool = True, sweep=None) -> None:
+        if sweep is None and self._try_view_resident(t, q):
+            return
         t0 = _time.perf_counter()
         if sweep is not None:
             s0 = _time.perf_counter()
