@@ -169,15 +169,19 @@ class TemporalGraph:
             self._resident_lock.release()
             raise
 
-    def resident_discard(self) -> None:
+    def resident_discard(self, log_replaced: bool = False) -> None:
         """Drop the resident sweep. Callers that hit device trouble
         mid-dispatch MUST call this while still holding the acquired lock:
         a partially applied delta leaves the device buffers inconsistent
-        with the host fold, and the next acquire must re-pin."""
+        with the host fold, and the next acquire must re-pin.
+        ``log_replaced`` also clears the broken latch — overflow is a
+        property of the log, not of the graph object."""
         self._resident = None
         self._resident_version = -1
         self._resident_n = 0
         self._post_pin_min = 2**62
+        if log_replaced:
+            self._resident_broken = False
 
     # ---- maintenance ----
 
@@ -192,10 +196,9 @@ class TemporalGraph:
         with self._cache_lock:
             self._cache.clear()
         with self._resident_lock:
-            self._resident = None   # a swapped log may reuse version ids
-            self._resident_version = -1
-            self._resident_n = 0
-            self._post_pin_min = 2**62
+            # a swapped log may reuse version ids, and a previously
+            # oversized log's broken latch must not outlive it
+            self.resident_discard(log_replaced=True)
 
     def checkpoint(self, path: str) -> None:
         from ..persist.checkpoint import save_log

@@ -58,10 +58,14 @@ class SweepBuilder:
     """
 
     def __init__(self, log: EventLog, *, include_occurrences: bool = False,
-                 pad: str = "pow2"):
+                 pad: str = "pow2", track_rows: bool = True,
+                 preseed_pairs: bool = False):
+        if include_occurrences and not track_rows:
+            raise ValueError("occurrence views need the add-row lists")
         self.log = log.pin()
         self.include_occurrences = include_occurrences
         self.pad = pad
+        self.track_rows = track_rows
         self._t = self.log.column("time")
         self._k = self.log.column("kind")
         self._s = self.log.column("src")
@@ -75,6 +79,16 @@ class SweepBuilder:
         self.uv = np.unique(np.concatenate([self._s, d_real])) \
             if len(self._s) else np.empty(0, np.int64)
         self._ok = len(self.uv) < (1 << 31)
+        # per-row dense ids, computed ONCE: per-hop _advance slices these
+        # instead of re-running searchsorted over the dictionary for every
+        # delta (the dominant host cost of a columnar sweep). Skipped above
+        # 2^23 events, where the 16B/event would hurt more than it helps.
+        if self._ok and 0 < len(self._s) <= (1 << 23):
+            self._sd_all = np.searchsorted(self.uv, self._s)
+            self._dd_all = np.zeros(len(self._d), np.int64)
+            self._dd_all[is_e] = np.searchsorted(self.uv, d_real)
+        else:
+            self._sd_all = self._dd_all = None
         nv = len(self.uv)
         # dense vertex fold state
         self.v_lat = np.full(nv, INT64_MIN, np.int64)
@@ -89,6 +103,32 @@ class SweepBuilder:
         # the same pair keys packed (dense_d, dense_s), kept sorted — the
         # dst-incidence index for tombstone joins
         self.e_enc_dst = np.empty(0, np.int64)
+        # preseed: start the pair table with EVERY pair the log ever
+        # mentions (alive=False, times at the sentinel). No pair is ever
+        # "fresh" afterwards, so the per-hop sorted inserts and the
+        # history-vs-new-pair joins vanish; the per-hop incident join over
+        # all pairs generates exactly build_view's all-pairs × all-deletes
+        # killList marks (a dead mark before a pair's first add loses to
+        # the later add in the latest-wins fold — same outcome as the
+        # historical join it replaces). The columnar engines opt in;
+        # semantics stay bit-identical (tested against build_view).
+        self.e_seen = np.empty(0, bool)   # pair has real marks (firsts set)
+        self._preseeded = False
+        if preseed_pairs and self._ok and is_e.any():
+            sd_e = np.searchsorted(self.uv, self._s[is_e]) \
+                if self._sd_all is None else self._sd_all[is_e]
+            dd_e = np.searchsorted(self.uv, d_real) \
+                if self._dd_all is None else self._dd_all[is_e]
+            enc_all = np.unique(self._pack(sd_e, dd_e))
+            self.e_enc = enc_all
+            self.e_lat = np.full(len(enc_all), INT64_MIN, np.int64)
+            self.e_alive = np.zeros(len(enc_all), bool)
+            self.e_first = np.full(len(enc_all), INT64_MIN, np.int64)
+            self.e_seen = np.zeros(len(enc_all), bool)
+            self.e_enc_dst = np.sort(
+                ((enc_all & _ENC_MASK) << _ENC_SHIFT)
+                | (enc_all >> _ENC_SHIFT))
+            self._preseeded = True
         # delete history: (dense vertex, time), sorted by vertex
         self.dh_v = np.empty(0, np.int64)
         self.dh_t = np.empty(0, np.int64)
@@ -156,20 +196,31 @@ class SweepBuilder:
         is_ed = k == EDGE_DELETE
         uvd = uenc = None  # touched entities, recorded into last_delta below
 
-        new_ea = rows[is_ea]
-        new_va = rows[is_va]
-        self._ea_rows = np.insert(
-            self._ea_rows, np.searchsorted(self._ea_rows, new_ea), new_ea)
-        self._va_rows = np.insert(
-            self._va_rows, np.searchsorted(self._va_rows, new_va), new_va)
+        if self.track_rows:
+            new_ea = rows[is_ea]
+            new_va = rows[is_va]
+            self._ea_rows = np.insert(
+                self._ea_rows, np.searchsorted(self._ea_rows, new_ea), new_ea)
+            self._va_rows = np.insert(
+                self._va_rows, np.searchsorted(self._va_rows, new_va), new_va)
 
-        ds_ea = self._dense(s[is_ea])
-        dd_ea = self._dense(d[is_ea])
-        dv_del = self._dense(s[is_vd])
+        if self._sd_all is not None:
+            sd, dd = self._sd_all[rows], self._dd_all[rows]
+            ds_ea, dd_ea = sd[is_ea], dd[is_ea]
+            dv_del = sd[is_vd]
+            dv_add = sd[is_va]
+            ds_ed, dd_ed = sd[is_ed], dd[is_ed]
+        else:
+            ds_ea = self._dense(s[is_ea])
+            dd_ea = self._dense(d[is_ea])
+            dv_del = self._dense(s[is_vd])
+            dv_add = self._dense(s[is_va])
+            ds_ed = self._dense(s[is_ed])
+            dd_ed = self._dense(d[is_ed])
         t_del = t[is_vd]
 
         # -- vertex delta fold: adds + edge-endpoint revivals vs deletes --
-        v_ids = np.concatenate([self._dense(s[is_va]), ds_ea, dd_ea, dv_del])
+        v_ids = np.concatenate([dv_add, ds_ea, dd_ea, dv_del])
         v_t = np.concatenate([t[is_va], t[is_ea], t[is_ea], t_del])
         v_al = np.zeros(len(v_ids), bool)
         v_al[: len(v_ids) - len(dv_del)] = True
@@ -184,8 +235,6 @@ class SweepBuilder:
 
         # -- edge delta marks: own add/delete events --
         enc_ea = self._pack(ds_ea, dd_ea)
-        ds_ed = self._dense(s[is_ed])
-        dd_ed = self._dense(d[is_ed])
         enc_ed = self._pack(ds_ed, dd_ed)
         marks_enc = [enc_ea, enc_ed]
         marks_t = [t[is_ea], t[is_ed]]
@@ -193,11 +242,14 @@ class SweepBuilder:
 
         delta_enc = np.unique(np.concatenate([enc_ea, enc_ed])) \
             if (len(enc_ea) or len(enc_ed)) else np.empty(0, np.int64)
-        pos = np.searchsorted(self.e_enc, delta_enc)
-        pos_c = np.clip(pos, 0, max(len(self.e_enc) - 1, 0))
-        known = (self.e_enc[pos_c] == delta_enc) if len(self.e_enc) \
-            else np.zeros(len(delta_enc), bool)
-        new_enc = delta_enc[~known]
+        if self._preseeded:
+            new_enc = delta_enc[:0]   # every pair is in the table already
+        else:
+            pos = np.searchsorted(self.e_enc, delta_enc)
+            pos_c = np.clip(pos, 0, max(len(self.e_enc) - 1, 0))
+            known = (self.e_enc[pos_c] == delta_enc) if len(self.e_enc) \
+                else np.zeros(len(delta_enc), bool)
+            new_enc = delta_enc[~known]
 
         if len(dv_del):
             # delta deletes × (pairs known before this hop ∪ NEW delta pairs)
@@ -227,6 +279,7 @@ class SweepBuilder:
                 marks_a.append(np.zeros(len(hrows), bool))
 
         all_enc = np.concatenate(marks_enc)
+        epos_known = None
         if len(all_enc):
             all_t = np.concatenate(marks_t)
             all_a = np.concatenate(marks_a)
@@ -236,24 +289,39 @@ class SweepBuilder:
             uknown = (self.e_enc[upos_c] == uenc) if len(self.e_enc) \
                 else np.zeros(len(uenc), bool)
             # existing pairs: delta marks are strictly later — overwrite
-            self.e_lat[upos_c[uknown]] = elat_d[uknown]
-            self.e_alive[upos_c[uknown]] = ealive_d[uknown]
+            # (firsts only fill slots that never saw a real mark — preseeded
+            # pairs exist in the table before their first event)
+            kpos = upos_c[uknown]
+            self.e_lat[kpos] = elat_d[uknown]
+            self.e_alive[kpos] = ealive_d[uknown]
+            self.e_first[kpos] = np.where(self.e_seen[kpos],
+                                          self.e_first[kpos],
+                                          efirst_d[uknown])
+            self.e_seen[kpos] = True
             # new pairs: insert (fold already merged their full history,
             # including historical tombstones, so firsts are exact)
             fresh = ~uknown
+            if not fresh.any():
+                # positions are final (no inserts shifted them): last_delta
+                # reuses them instead of re-searching the whole table
+                epos_known = upos_c
             if fresh.any():
                 at = upos[fresh]
                 self.e_enc = np.insert(self.e_enc, at, uenc[fresh])
                 self.e_lat = np.insert(self.e_lat, at, elat_d[fresh])
                 self.e_alive = np.insert(self.e_alive, at, ealive_d[fresh])
                 self.e_first = np.insert(self.e_first, at, efirst_d[fresh])
+                self.e_seen = np.insert(self.e_seen, at,
+                                        np.ones(fresh.sum(), bool))
                 enc2 = (((uenc[fresh] & _ENC_MASK) << _ENC_SHIFT)
                         | (uenc[fresh] >> _ENC_SHIFT))
                 enc2 = np.sort(enc2)
                 self.e_enc_dst = np.insert(
                     self.e_enc_dst, np.searchsorted(self.e_enc_dst, enc2), enc2)
 
-        if len(dv_del):
+        if len(dv_del) and not self._preseeded:
+            # the delete history only feeds the new-pair join, which a
+            # preseeded table never takes (no pair is ever new)
             self.dh_v = np.concatenate([self.dh_v, dv_del])
             self.dh_t = np.concatenate([self.dh_t, t_del])
             order = np.argsort(self.dh_v, kind="stable")
@@ -265,7 +333,8 @@ class SweepBuilder:
         # pair overwrite / fresh insert / tombstone join) produced the value.
         tv = uvd if uvd is not None else np.empty(0, np.int64)
         te = uenc if uenc is not None else np.empty(0, np.int64)
-        epos = np.searchsorted(self.e_enc, te)
+        epos = epos_known if epos_known is not None \
+            else np.searchsorted(self.e_enc, te)
         self.last_delta = {
             "v_idx": tv, "v_lat": self.v_lat[tv],
             "v_alive": self.v_alive[tv], "v_first": self.v_first[tv],
@@ -274,6 +343,11 @@ class SweepBuilder:
         }
 
     def _emit(self, time: int) -> GraphView:
+        if not self.track_rows:
+            raise RuntimeError(
+                "this SweepBuilder was built with track_rows=False (fold "
+                "state only — the columnar/device engines); use a default "
+                "one to emit GraphViews")
         act_dense = np.flatnonzero(self.v_alive)
         act_vids = self.uv[act_dense]  # uv ascending ⇒ dense order = id order
         act_latest = self.v_lat[act_dense]

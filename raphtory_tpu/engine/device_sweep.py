@@ -78,12 +78,18 @@ class GlobalTables:
             raise ValueError("log has >= 2^31 distinct vertices — the packed "
                              "pair key space is exhausted; use build_view")
         self.uv = sw.uv
-        is_e = (sw._k == EDGE_ADD) | (sw._k == EDGE_DELETE)
-        if is_e.any():
-            enc = (sw._dense(sw._s[is_e]) << _ENC_SHIFT) | sw._dense(sw._d[is_e])
-            self.all_enc = np.unique(enc)
+        if sw._preseeded:
+            # a preseeded sweep's pair table IS the all-pairs table (and
+            # never grows) — no second unique over the edge events
+            self.all_enc = sw.e_enc
         else:
-            self.all_enc = np.empty(0, np.int64)
+            is_e = (sw._k == EDGE_ADD) | (sw._k == EDGE_DELETE)
+            if is_e.any():
+                enc = ((sw._dense(sw._s[is_e]) << _ENC_SHIFT)
+                       | sw._dense(sw._d[is_e]))
+                self.all_enc = np.unique(enc)
+            else:
+                self.all_enc = np.empty(0, np.int64)
 
         self.n = len(self.uv)
         self.m = len(self.all_enc)
@@ -210,7 +216,8 @@ class DeviceSweep:
     """
 
     def __init__(self, log: EventLog):
-        self.sw = SweepBuilder(log)
+        # fold state only (shells are vertex-side) — no add-row tracking
+        self.sw = SweepBuilder(log, track_rows=False, preseed_pairs=True)
         self.tables = GlobalTables(self.sw)
         t = self.tables
         self.uv = t.uv

@@ -57,6 +57,39 @@ def _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
     return me, mv
 
 
+def _masks_from_deltas(tdt, H: int, W: int,
+                       be_lat, be_alive, bv_lat, bv_alive,
+                       de_pos, de_lat, de_alive,
+                       dv_pos, dv_lat, dv_alive, T_col, w_col):
+    """Device-side fold-column rebuild: hop 0's full state plus per-hop
+    touched-entity deltas (scatter-SET in hop order — delete-wins and
+    revivals are already resolved by the host fold, so the delta VALUES
+    are exact) replace the ``[H, m_pad]`` host-built columns. A sweep
+    ships O(base + Σ delta) bytes instead of O(H · m_pad) — the term that
+    made the host fold+transfer the binding cost of the headline sweep.
+    Same windowing test as ``_column_masks``; pad rows carry a huge
+    positive index and are dropped by the scatter."""
+    info = jnp.iinfo(tdt)
+    lo = jnp.clip(T_col - w_col, info.min, info.max).astype(tdt)   # [C]
+    nowin = w_col < 0
+
+    def build(b_lat, b_alive, d_pos, d_lat, d_alive):
+        cur_l, cur_a, cols = b_lat, b_alive, []
+        for h in range(H):     # H static and small: unrolled 1D scatters
+            if h:
+                cur_l = cur_l.at[d_pos[h]].set(d_lat[h], mode="drop")
+                cur_a = cur_a.at[d_pos[h]].set(d_alive[h], mode="drop")
+            sl = slice(h * W, (h + 1) * W)
+            cols.append(cur_a[:, None]
+                        & (nowin[sl][None, :]
+                           | (cur_l[:, None] >= lo[sl][None, :])))
+        return jnp.concatenate(cols, axis=1)   # [len, H*W] hop-major
+
+    me = build(be_lat, be_alive, de_pos, de_lat, de_alive)
+    mv = build(bv_lat, bv_alive, dv_pos, dv_lat, dv_alive)
+    return me, mv
+
+
 def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
                       tol: float, max_steps: int, r_init=None):
     """Power iteration over per-column masks ``me [m_pad, C]`` /
@@ -129,6 +162,83 @@ def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
                                  r_init=rest[0] if warm else None)
 
     return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
+                    U_e: int, U_v: int, tdt: str, warm: bool,
+                    algo_args: tuple):
+    """Delta-fed columnar kernels: masks rebuilt on device from base state
+    + per-hop deltas (``_masks_from_deltas``), then the shared algorithm
+    body. ``kind``: pagerank | cc | bfs; ``algo_args`` is the algorithm's
+    static parameter tuple."""
+    tdt_ = jnp.dtype(tdt)
+
+    def run(e_src, e_dst, be_lat, be_alive, bv_lat, bv_alive,
+            de_pos, de_lat, de_alive, dv_pos, dv_lat, dv_alive,
+            T_col, w_col, *rest):
+        me, mv = _masks_from_deltas(
+            tdt_, H, W, be_lat, be_alive, bv_lat, bv_alive,
+            de_pos, de_lat, de_alive, dv_pos, dv_lat, dv_alive,
+            T_col, w_col)
+        if kind == "pagerank":
+            damping, tol, max_steps = algo_args
+            return _pagerank_columns(me, mv, e_src, e_dst, n_pad,
+                                     damping, tol, max_steps,
+                                     r_init=rest[0] if warm else None)
+        if kind == "cc":
+            (max_steps,) = algo_args
+            return _cc_columns(me, mv, e_src, e_dst, n_pad, max_steps)
+        max_steps, directed = algo_args
+        return _bfs_columns(me, mv, e_src, e_dst, n_pad, max_steps,
+                            directed, rest[0], 1.0)   # rest[0]: seed mask
+
+    return jax.jit(run)
+
+
+def _pad_hop_deltas(deltas, H: int, tdt):
+    """Pad per-hop (pos, lat, alive) delta lists to a fixed ``[H, U]``
+    shape (hop 0 is empty: its state IS the base). Pad index 2^31-1 is
+    dropped by the device scatter."""
+    longest = max((len(p) for p, _, _ in deltas), default=1)
+    U = max(256, 1 << int(np.ceil(np.log2(max(longest, 1)))))
+    pos = np.full((H, U), 2**31 - 1, np.int32)
+    lat = np.zeros((H, U), tdt)
+    alive = np.zeros((H, U), bool)
+    for h, (p, l, a) in enumerate(deltas):
+        pos[h, : len(p)] = p
+        lat[h, : len(l)] = l
+        alive[h, : len(a)] = a
+    return U, pos, lat, alive
+
+
+def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
+                      windows, *, algo_args: tuple, seed_mask=None,
+                      e_src_dev=None, e_dst_dev=None, r_init=None):
+    """Dispatch a delta-fed columnar kernel (``kind``: pagerank|cc|bfs)
+    over ``_HopBatched._fold_deltas`` output."""
+    H, C, _, T_col, w_col = _column_layout(hop_times, windows)
+    W = C // H
+    be_lat, be_alive, bv_lat, bv_alive = base
+    tdt = tables.tdtype
+    U_e, de_pos, de_lat, de_alive = _pad_hop_deltas(deltas_e, H, tdt)
+    U_v, dv_pos, dv_lat, dv_alive = _pad_hop_deltas(deltas_v, H, tdt)
+    runner = _compiled_delta(kind, tables.n_pad, tables.m_pad, H, W,
+                             U_e, U_v, np.dtype(tdt).name,
+                             r_init is not None, tuple(algo_args))
+    extra = []
+    if seed_mask is not None:
+        extra.append(seed_mask)
+    if r_init is not None:
+        extra.append(r_init)
+    return runner(
+        e_src_dev if e_src_dev is not None else jnp.asarray(tables.e_src),
+        e_dst_dev if e_dst_dev is not None else jnp.asarray(tables.e_dst),
+        *(jnp.asarray(a) for a in (be_lat, be_alive, bv_lat, bv_alive,
+                                   de_pos, de_lat, de_alive,
+                                   dv_pos, dv_lat, dv_alive,
+                                   T_col, w_col)),
+        *(jnp.asarray(a) for a in extra))
 
 
 def _cc_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int):
@@ -293,7 +403,9 @@ class _HopBatched:
     identical to ``chunks=1`` (hop-major concatenation; tested)."""
 
     def __init__(self, log: EventLog):
-        self.sw = SweepBuilder(log)
+        # fold state only — the columnar engines never emit GraphViews, so
+        # the per-hop add-row list merges are skipped entirely
+        self.sw = SweepBuilder(log, track_rows=False, preseed_pairs=True)
         self.tables = GlobalTables(self.sw)
         #: host seconds spent folding + writing columns in the LAST run()
         #: (callers report it as snapshot-build time)
@@ -302,6 +414,8 @@ class _HopBatched:
         # that only use the host fold — e.g. the column-sharded mesh
         # route — never pay the device transfer), then cache
         self._edges = None
+        # running host base for the delta-fold path (built on first use)
+        self._delta_base = None
 
     @property
     def _e_src(self):
@@ -321,7 +435,22 @@ class _HopBatched:
     #: warm-start from the previous chunk's solution)
     supports_warm_start = False
 
+    #: subclasses whose kernel has a delta-fed variant (device-side mask
+    #: rebuild, ``_masks_from_deltas``) — SSSP's weight columns are
+    #: host-folded, so it stays on the host-column path
+    supports_delta_fold = False
+
+    def _use_delta_fold(self) -> bool:
+        import os
+
+        if not self.supports_delta_fold:
+            return False
+        return os.environ.get("RTPU_FOLD", "delta") != "host"
+
     def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
+        raise NotImplementedError
+
+    def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
         raise NotImplementedError
 
     def run(self, hop_times, windows, chunks: int = 1,
@@ -347,15 +476,23 @@ class _HopBatched:
                     "%d hops do not split into %d equal chunks — running "
                     "one cold dispatch (warm_start has no effect)",
                     len(hop_times), chunks)
+            if self._use_delta_fold():
+                hop_times, payload = self._fold_deltas(hop_times,
+                                                       hop_callback)
+                return self._dispatch_deltas(payload, hop_times, windows)
             hop_times, cols = self._fold_columns(hop_times, hop_callback)
             return self._dispatch_cols(cols, hop_times, windows)
         per = len(hop_times) // chunks
         W = len(normalize_windows(windows))
+        delta = self._use_delta_fold()
         outs = []
         steps = jnp.int32(0)
         for c in range(chunks):
             group = hop_times[c * per: (c + 1) * per]
-            group, cols = self._fold_columns(group, hop_callback)
+            if delta:
+                group, payload = self._fold_deltas(group, hop_callback)
+            else:
+                group, cols = self._fold_columns(group, hop_callback)
             r_init = None
             if warm_start and outs:
                 # previous chunk's last hop: rows [-W:] are its W windowed
@@ -363,14 +500,22 @@ class _HopBatched:
                 # device values — the host pipeline stays async
                 tail = outs[-1][-W:]                       # [W, n_pad]
                 r_init = jnp.tile(tail, (per, 1)).T        # [n_pad, per*W]
-            out, st = self._dispatch_cols(cols, group, windows,
-                                          r_init=r_init)  # async
+            if delta:
+                out, st = self._dispatch_deltas(payload, group, windows,
+                                                r_init=r_init)  # async
+            else:
+                out, st = self._dispatch_cols(cols, group, windows,
+                                              r_init=r_init)   # async
             outs.append(out)
             steps = jnp.maximum(steps, st)
         return jnp.concatenate(outs, axis=0), steps
 
     def _fold_columns(self, hop_times, hop_callback=None):
         f0 = _time.perf_counter()
+        # this path advances the shared SweepBuilder WITHOUT updating the
+        # running delta base — a later delta-fold call must rebuild it or
+        # it would scatter one hop's delta onto a stale base
+        self._delta_base = None
         t = self.tables
         hop_times = [int(x) for x in hop_times]
         if sorted(hop_times) != hop_times:
@@ -425,6 +570,80 @@ class _HopBatched:
         self.fold_seconds += _time.perf_counter() - f0
         return hop_times, (e_lat, e_alive, v_lat, v_alive)
 
+    def _apply_delta_to_base(self):
+        """Scatter the sweep's last delta into the RUNNING host base
+        (O(delta)); returns the delta in engine coordinates."""
+        t = self.tables
+        d = self.sw.last_delta
+        epos = t.eng_pos(d["e_enc"]).astype(np.int32)
+        e_lat = t.cast_times(d["e_lat"])
+        e_alive = d["e_alive"].astype(bool)
+        v_idx = d["v_idx"].astype(np.int32)
+        v_lat = t.cast_times(d["v_lat"])
+        v_alive = d["v_alive"].astype(bool)
+        be_lat, be_alive, bv_lat, bv_alive = self._delta_base
+        be_lat[epos] = e_lat
+        be_alive[epos] = e_alive
+        bv_lat[v_idx] = v_lat
+        bv_alive[v_idx] = v_alive
+        return (epos, e_lat, e_alive), (v_idx, v_lat, v_alive)
+
+    def _fold_deltas(self, hop_times, hop_callback=None):
+        """Delta-fold: the state at each batch's first hop (the base) plus
+        per-hop touched-entity (pos, lat, alive) lists — the device
+        rebuilds the hop columns (``_masks_from_deltas``). Host work and
+        H2D bytes are O(base + Σ delta) instead of O(H · m_pad): the cost
+        that made the host fold the binding term of the headline sweep.
+        The base is a RUNNING array updated by O(delta) scatters, so
+        chunked (pipelined) sweeps pay the full-table materialisation
+        once, not per chunk."""
+        f0 = _time.perf_counter()
+        t = self.tables
+        hop_times = [int(x) for x in hop_times]
+        if sorted(hop_times) != hop_times:
+            raise ValueError("hop_times must ascend")
+        if self.sw.t_prev is not None and hop_times[0] < self.sw.t_prev:
+            raise ValueError(
+                f"hop_times must continue forward from the previous batch "
+                f"(got {hop_times[0]} < {self.sw.t_prev}); build a fresh "
+                f"{type(self).__name__} to go back in history")
+        tdt = t.tdtype
+        deltas_e, deltas_v = [], []
+        ship_base = None
+        empty = (np.empty(0, np.int32), np.empty(0, tdt),
+                 np.empty(0, bool))
+        for j, T in enumerate(hop_times):
+            self.sw._advance(T)
+            if hop_callback is not None:
+                hop_callback(T, self.sw)
+            if self._delta_base is None:
+                # first batch, first hop: materialise from the full fold
+                be_lat = np.full(t.m_pad, t.tmin, tdt)
+                be_alive = np.zeros(t.m_pad, bool)
+                pos = t.eng_pos(self.sw.e_enc)
+                be_lat[pos] = t.cast_times(self.sw.e_lat)
+                be_alive[pos] = self.sw.e_alive
+                bv_lat = np.full(t.n_pad, t.tmin, tdt)
+                bv_alive = np.zeros(t.n_pad, bool)
+                nv = len(self.sw.uv)
+                bv_lat[:nv] = t.cast_times(self.sw.v_lat)
+                bv_alive[:nv] = self.sw.v_alive
+                self._delta_base = [be_lat, be_alive, bv_lat, bv_alive]
+            else:
+                de, dv = self._apply_delta_to_base()
+                if j > 0:
+                    deltas_e.append(de)
+                    deltas_v.append(dv)
+            if j == 0:
+                # snapshot the running base as this batch's upload (the
+                # arrays keep mutating through later hops; jnp.asarray is
+                # async, so the copy must be taken now)
+                ship_base = tuple(a.copy() for a in self._delta_base)
+                deltas_e.append(empty)
+                deltas_v.append(empty)
+        self.fold_seconds += _time.perf_counter() - f0
+        return hop_times, (ship_base, deltas_e, deltas_v)
+
 
 class HopBatchedPageRank(_HopBatched):
     """Windowed PageRank over a full hop sweep in one device call.
@@ -435,6 +654,7 @@ class HopBatchedPageRank(_HopBatched):
     """
 
     supports_warm_start = True   # power iteration is a contraction
+    supports_delta_fold = True
 
     def __init__(self, log: EventLog, damping: float = 0.85,
                  tol: float = 1e-7, max_steps: int = 20):
@@ -447,10 +667,19 @@ class HopBatchedPageRank(_HopBatched):
             damping=self.damping, tol=self.tol, max_steps=self.max_steps,
             e_src_dev=self._e_src, e_dst_dev=self._e_dst, r_init=r_init)
 
+    def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
+        return run_columns_delta(
+            "pagerank", self.tables, *payload, hop_times, windows,
+            algo_args=(float(self.damping), float(self.tol),
+                       int(self.max_steps)),
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst, r_init=r_init)
+
 
 class HopBatchedBFS(_HopBatched):
     """Windowed BFS hop counting over a full sweep in one call; distances
     are f32 with inf for unreached (SSSP-with-unit-weights semantics)."""
+
+    supports_delta_fold = True
 
     def __init__(self, log: EventLog, seeds, directed: bool = False,
                  max_steps: int = 100):
@@ -466,6 +695,14 @@ class HopBatchedBFS(_HopBatched):
             directed=self.directed, max_steps=self.max_steps,
             e_src_dev=self._e_src, e_dst_dev=self._e_dst)
 
+    def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
+        assert r_init is None   # guarded by supports_warm_start
+        return run_columns_delta(
+            "bfs", self.tables, *payload, hop_times, windows,
+            algo_args=(int(self.max_steps), bool(self.directed)),
+            seed_mask=_seed_mask(self.tables, self.seeds),
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst)
+
 
 class HopBatchedSSSP(HopBatchedBFS):
     """Weighted min-plus traversal over a full sweep in one call.
@@ -476,6 +713,8 @@ class HopBatchedSSSP(HopBatchedBFS):
     ``[H, m_pad]`` columns next to the alive/lat columns; pairs that never
     set the key weigh 1.0 (``SSSP.message``'s NaN rule). Immutable keys
     (earliest-wins) are refused — the ascending fold is last-wins."""
+
+    supports_delta_fold = False   # weight columns are host-folded
 
     def __init__(self, log: EventLog, seeds, weight_prop: str,
                  directed: bool = False, max_steps: int = 100):
@@ -552,9 +791,18 @@ class HopBatchedCC(_HopBatched):
     """Windowed connected components over a full hop sweep in one call;
     labels decode via ``tables.uv[label]`` (min vid of the component)."""
 
+    supports_delta_fold = True
+
     def __init__(self, log: EventLog, max_steps: int = 100):
         super().__init__(log)
         self.max_steps = max_steps
+
+    def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
+        assert r_init is None   # guarded by supports_warm_start
+        return run_columns_delta(
+            "cc", self.tables, *payload, hop_times, windows,
+            algo_args=(int(self.max_steps),),
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst)
 
     def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
         assert r_init is None   # guarded by supports_warm_start

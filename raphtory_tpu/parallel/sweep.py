@@ -39,7 +39,7 @@ class ShardedSweep:
     """
 
     def __init__(self, log: EventLog, n_shards: int):
-        self.sw = SweepBuilder(log)
+        self.sw = SweepBuilder(log, track_rows=False, preseed_pairs=True)
         self.t = GlobalTables(self.sw)
         t = self.t
         if t.n_pad % n_shards:

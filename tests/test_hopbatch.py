@@ -289,3 +289,69 @@ def test_hopbatch_weighted_sssp_chunked_matches_one_dispatch():
                            max_steps=60).run(hops, windows,
                                              chunks=chunks)[0])
         np.testing.assert_array_equal(one, many)
+
+
+def test_delta_fold_matches_host_columns(monkeypatch):
+    """The device-rebuilt masks (base + per-hop deltas) produce bitwise
+    the same results as the host-built [H, m_pad] columns, deletes and
+    revivals included, for PR and CC and BFS."""
+    import numpy as np
+
+    from raphtory_tpu.engine.hopbatch import (HopBatchedBFS, HopBatchedCC,
+                                              HopBatchedPageRank)
+
+    log = random_log(np.random.default_rng(11), n_events=900, n_ids=40,
+                     t_span=1000)   # includes deletes
+    hops = [300, 500, 700, 900]
+    windows = [250, None]
+
+    for cls, kw in ((HopBatchedPageRank, dict(tol=0.0, max_steps=8)),
+                    (HopBatchedCC, dict(max_steps=30)),
+                    (HopBatchedBFS, dict(seeds=(1, 2), max_steps=30))):
+        monkeypatch.setenv("RTPU_FOLD", "host")
+        host, s1 = cls(log, **kw).run(hops, windows)
+        monkeypatch.setenv("RTPU_FOLD", "delta")
+        delta, s2 = cls(log, **kw).run(hops, windows)
+        np.testing.assert_array_equal(np.asarray(host), np.asarray(delta))
+        assert int(s1) == int(s2)
+
+
+def test_delta_fold_chunked_warm_start(monkeypatch):
+    import numpy as np
+
+    from raphtory_tpu.engine.hopbatch import HopBatchedPageRank
+
+    log = random_log(np.random.default_rng(12), n_events=900, n_ids=40,
+                     t_span=1000)
+    hops = [200, 400, 600, 800]
+    monkeypatch.setenv("RTPU_FOLD", "delta")
+    one, _ = HopBatchedPageRank(log, tol=1e-9, max_steps=300).run(
+        hops, [300], chunks=1)
+    piped, _ = HopBatchedPageRank(log, tol=1e-9, max_steps=300).run(
+        hops, [300], chunks=2, warm_start=True)
+    np.testing.assert_allclose(np.asarray(one), np.asarray(piped),
+                               atol=5e-7)
+
+
+def test_fold_mode_toggle_keeps_delta_base_fresh(monkeypatch):
+    """host-path calls on a shared engine invalidate the running delta
+    base, so a later delta call rebuilds instead of scattering one hop
+    onto a stale base."""
+    import numpy as np
+
+    from raphtory_tpu.engine.hopbatch import HopBatchedPageRank
+
+    log = random_log(np.random.default_rng(13), n_events=900, n_ids=40,
+                     t_span=1000)
+    ref_log = random_log(np.random.default_rng(13), n_events=900, n_ids=40,
+                         t_span=1000)
+    hb = HopBatchedPageRank(log, tol=0.0, max_steps=8)
+    monkeypatch.setenv("RTPU_FOLD", "delta")
+    hb.run([100, 200], [None])
+    monkeypatch.setenv("RTPU_FOLD", "host")
+    hb.run([300, 400], [None])
+    monkeypatch.setenv("RTPU_FOLD", "delta")
+    got, _ = hb.run([500, 600], [None])
+    ref, _ = HopBatchedPageRank(ref_log, tol=0.0, max_steps=8).run(
+        [500, 600], [None])
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
