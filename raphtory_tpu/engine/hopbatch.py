@@ -105,9 +105,12 @@ def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
     to its own alive set, floored so newly-alive vertices get mass, and
     renormalised."""
     C = me.shape[1]
-    mef = me.astype(jnp.float32)                    # [m_pad, C]
-    # out-degree per column: combine at src (unsorted scatter, once)
-    out_deg = jax.ops.segment_sum(mef, e_src, num_segments=n_pad)
+    # out-degree per column: combine at src (unsorted scatter, once). The
+    # f32 view of the mask is TRANSIENT (fused into the scatter-add): a
+    # materialised [m_pad, C] f32 mask is 4x the bool one and at
+    # 33M x 128 columns it alone would exhaust a v5e's HBM
+    out_deg = jax.ops.segment_sum(me.astype(jnp.float32), e_src,
+                                  num_segments=n_pad)
     n_act = jnp.maximum(jnp.sum(mv.astype(jnp.float32), axis=0), 1.0)
     r0 = jnp.where(mv, 1.0 / n_act[None, :], 0.0).astype(jnp.float32)
     if r_init is not None:
@@ -121,7 +124,9 @@ def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
 
     def body(carry):
         step, r, halted = carry
-        payload = (r * inv_deg)[e_src, :] * mef     # row gather [m, C]
+        # row gather [m, C]; the bool mask gates via where — only the bool
+        # mask stays live across the loop
+        payload = jnp.where(me, (r * inv_deg)[e_src, :], 0.0)
         agg = jax.ops.segment_sum(
             payload, e_dst, num_segments=n_pad, indices_are_sorted=True)
         dangling = jnp.sum(jnp.where(dangling_mask, r, 0.0), axis=0)
