@@ -172,10 +172,12 @@ def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
 @functools.lru_cache(maxsize=64)
 def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
                     U_e: int, U_v: int, tdt: str, warm: bool,
-                    algo_args: tuple):
+                    algo_args: tuple, weighted: bool = False,
+                    U_w: int = 0):
     """Delta-fed columnar kernels: masks rebuilt on device from base state
     + per-hop deltas (``_masks_from_deltas``), then the shared algorithm
-    body. ``kind``: pagerank | cc | bfs; ``algo_args`` is the algorithm's
+    body. ``kind``: pagerank | cc | bfs (``weighted`` adds a per-pair
+    weight state rebuilt the same way); ``algo_args`` is the algorithm's
     static parameter tuple."""
     tdt_ = jnp.dtype(tdt)
 
@@ -195,8 +197,18 @@ def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
             (max_steps,) = algo_args
             return _cc_columns(me, mv, e_src, e_dst, n_pad, max_steps)
         max_steps, directed = algo_args
+        ew = 1.0
+        if weighted:
+            _, w_base, dw_pos, dw_val = rest
+            cur_w, cols = w_base, []
+            for h in range(H):   # same unrolled rebuild as the masks
+                if h:
+                    cur_w = cur_w.at[dw_pos[h]].set(dw_val[h], mode="drop")
+                cols.append(jnp.broadcast_to(
+                    cur_w[:, None], (cur_w.shape[0], W)))
+            ew = jnp.concatenate(cols, axis=1)   # [m_pad, C] hop-major
         return _bfs_columns(me, mv, e_src, e_dst, n_pad, max_steps,
-                            directed, rest[0], 1.0)   # rest[0]: seed mask
+                            directed, rest[0], ew)   # rest[0]: seed mask
 
     return jax.jit(run)
 
@@ -219,21 +231,37 @@ def _pad_hop_deltas(deltas, H: int, tdt):
 
 def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
                       windows, *, algo_args: tuple, seed_mask=None,
-                      e_src_dev=None, e_dst_dev=None, r_init=None):
+                      e_src_dev=None, e_dst_dev=None, r_init=None,
+                      weight_base=None, weight_deltas=None):
     """Dispatch a delta-fed columnar kernel (``kind``: pagerank|cc|bfs)
-    over ``_HopBatched._fold_deltas`` output."""
+    over ``_HopBatched._fold_deltas`` output. ``weight_base`` +
+    ``weight_deltas`` ([(pos, val)] per hop) turn bfs into weighted SSSP
+    with the weight state rebuilt on device too."""
     H, C, _, T_col, w_col = _column_layout(hop_times, windows)
     W = C // H
     be_lat, be_alive, bv_lat, bv_alive = base
     tdt = tables.tdtype
     U_e, de_pos, de_lat, de_alive = _pad_hop_deltas(deltas_e, H, tdt)
     U_v, dv_pos, dv_lat, dv_alive = _pad_hop_deltas(deltas_v, H, tdt)
+    weighted = weight_base is not None
+    U_w = 0
+    if weighted:
+        longest = max((len(p) for p, _ in weight_deltas), default=1)
+        U_w = max(256, 1 << int(np.ceil(np.log2(max(longest, 1)))))
+        dw_pos = np.full((H, U_w), 2**31 - 1, np.int32)
+        dw_val = np.zeros((H, U_w), np.float32)
+        for h, (p, v) in enumerate(weight_deltas):
+            dw_pos[h, : len(p)] = p
+            dw_val[h, : len(v)] = v
     runner = _compiled_delta(kind, tables.n_pad, tables.m_pad, H, W,
                              U_e, U_v, np.dtype(tdt).name,
-                             r_init is not None, tuple(algo_args))
+                             r_init is not None, tuple(algo_args),
+                             weighted, U_w)
     extra = []
     if seed_mask is not None:
         extra.append(seed_mask)
+    if weighted:
+        extra.extend((weight_base, dw_pos, dw_val))
     if r_init is not None:
         extra.append(r_init)
     return runner(
@@ -719,7 +747,7 @@ class HopBatchedSSSP(HopBatchedBFS):
     set the key weigh 1.0 (``SSSP.message``'s NaN rule). Immutable keys
     (earliest-wins) are refused — the ascending fold is last-wins."""
 
-    supports_delta_fold = False   # weight columns are host-folded
+    supports_delta_fold = True   # weights rebuild on device too
 
     def __init__(self, log: EventLog, seeds, weight_prop: str,
                  directed: bool = False, max_steps: int = 100):
@@ -782,6 +810,38 @@ class HopBatchedSSSP(HopBatchedBFS):
         hop_times, cols = super()._fold_columns(hop_times, hop_callback)
         return hop_times, (*cols, self._weight_cols(hop_times))
 
+    def _weight_deltas(self, hop_times):
+        """Per-hop (pos, val) weight updates + the running state at hop 0
+        of this batch — the delta twin of ``_weight_cols``."""
+        wd = []
+        w_base = None
+        for j, T in enumerate(hop_times):
+            hi = int(np.searchsorted(self._w_t, T, side="right"))
+            pos = self._w_pos[self._w_cursor:hi].astype(np.int32)
+            val = self._w_val[self._w_cursor:hi]
+            if len(pos):
+                # last-wins per pair WITHIN the hop: XLA scatter order is
+                # undefined for duplicate indices, so the dedup must happen
+                # here (the host fold's sequential assignment is last-wins
+                # by construction)
+                u_last = np.unique(pos[::-1], return_index=True)[1]
+                sel = np.sort(len(pos) - 1 - u_last)
+                pos, val = pos[sel], val[sel]
+            if hi > self._w_cursor:
+                self._w_state[self._w_pos[self._w_cursor:hi]] = \
+                    self._w_val[self._w_cursor:hi]
+                self._w_cursor = hi
+            if j == 0:   # updates at/before hop 0 belong to the base
+                w_base = self._w_state.copy()
+                wd.append((pos[:0], val[:0]))
+            else:
+                wd.append((pos, val))
+        return w_base, wd
+
+    def _fold_deltas(self, hop_times, hop_callback=None):
+        hop_times, payload = super()._fold_deltas(hop_times, hop_callback)
+        return hop_times, (*payload, *self._weight_deltas(hop_times))
+
     def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
         assert r_init is None   # guarded by supports_warm_start
         *base, wcols = cols
@@ -790,6 +850,16 @@ class HopBatchedSSSP(HopBatchedBFS):
             directed=self.directed, max_steps=self.max_steps,
             e_src_dev=self._e_src, e_dst_dev=self._e_dst,
             weight_cols=wcols)
+
+    def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
+        assert r_init is None   # guarded by supports_warm_start
+        base, deltas_e, deltas_v, w_base, w_deltas = payload
+        return run_columns_delta(
+            "bfs", self.tables, base, deltas_e, deltas_v, hop_times,
+            windows, algo_args=(int(self.max_steps), bool(self.directed)),
+            seed_mask=_seed_mask(self.tables, self.seeds),
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst,
+            weight_base=w_base, weight_deltas=w_deltas)
 
 
 class HopBatchedCC(_HopBatched):

@@ -26,7 +26,8 @@ from .blockchain import (
 )
 from .citations import CitationParser
 from .embeddings import TemporalEmbeddings
-from .gab import GabMostUsedTopics, GabPostGraphParser, GabUserGraphParser
+from .gab import (GabMostUsedTopics, GabPostGraphParser,
+                  GabRawPostParser, GabUserGraphParser)
 from .ldbc import LDBCParser
 from .random_graph import RandomCommandSource, RandomJsonParser
 from .track_and_trace import TrackAndTraceParser, location_id
@@ -35,6 +36,7 @@ from .twitter_rumour import RumourParser
 __all__ = [
     "RandomCommandSource",
     "RandomJsonParser",
+    "GabRawPostParser",
     "GabUserGraphParser",
     "GabPostGraphParser",
     "GabMostUsedTopics",

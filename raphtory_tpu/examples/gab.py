@@ -11,6 +11,7 @@ topic vertices by in-degree with their string id/title properties.
 from __future__ import annotations
 
 import datetime as _dt
+import json as _json
 from dataclasses import dataclass
 
 import numpy as np
@@ -18,7 +19,7 @@ import numpy as np
 from ..algorithms.rankings import DegreeRanking
 from ..engine.program import Context
 from ..ingestion.parser import Parser
-from ..ingestion.updates import EdgeAdd, VertexAdd
+from ..ingestion.updates import EdgeAdd, VertexAdd, assign_id
 
 
 def _epoch(ts: str) -> int:
@@ -68,6 +69,85 @@ class GabPostGraphParser(GabUserGraphParser):
     def __init__(self, sep: str = ";", time_col: int = 0, src_col: int = 1,
                  dst_col: int = 4):
         super().__init__(sep, time_col, src_col, dst_col)
+
+
+class GabRawPostParser(Parser):
+    """Deep raw-Gab JSON model: one JSON post object per line, unfolded
+    into the reference's heterogeneous graph (``GabRawRouter.scala:28-130``
+    over the ``rawgraphmodel/GabPost.scala`` case-class tree):
+
+    * the post vertex carries ``user``/``likeCount``/``score``/``topic``
+      string props and ``type=post``;
+    * the author becomes a ``type=user`` vertex (id/name/username/verified
+      props) with ``userToPost`` AND ``postToUser`` edges;
+    * the topic becomes a ``type=topic`` vertex (id/title/category/
+      created_at props) with a ``postToTopic`` edge;
+    * a quoted/replied parent post unfolds ONE level (the reference's
+      single-recursion guard) plus a ``childToParent`` edge.
+
+    Ids are namespaced blake2b hashes (``assign_id``) instead of the
+    reference's clash-prone ``"user".hashCode + id`` / ``2^24 + hash``
+    scheme; unparseable lines are dropped (counted by the pipeline), like
+    the router's catch-all."""
+
+    NULL = "null"
+
+    def __call__(self, raw: str):
+        try:
+            post = _json.loads(raw)
+            if not isinstance(post, dict):
+                return []
+            return self._unfold(post, parent_vid=None)
+        except (ValueError, KeyError, TypeError, OverflowError,
+                AttributeError):
+            return []   # "Could not parse post"
+
+    def _unfold(self, post: dict, parent_vid):
+        t = _epoch(str(post["created_at"])[:19])
+        vid = assign_id(f"gab:post:{int(post['id'])}")
+        user = post.get("user")
+        user = user if isinstance(user, dict) else None
+        topic = post.get("topic")
+        topic = topic if isinstance(topic, dict) else None
+
+        def s(v):
+            return self.NULL if v is None else str(v)
+
+        out = [VertexAdd(t, vid, {
+            "user": s((user or {}).get("id")),
+            "likeCount": s(post.get("like_count")),
+            "score": s(post.get("score")),
+            "topic": s((topic or {}).get("id")),
+            "!type": "post",
+        })]
+        if user is not None:
+            uvid = assign_id(f"gab:user:{int(user['id'])}")
+            out.append(VertexAdd(t, uvid, {
+                "!type": "user",
+                "id": s(user.get("id")),
+                "name": s(user.get("name")),
+                "username": s(user.get("username")),
+                "verified": s(user.get("verified")),
+            }))
+            out.append(EdgeAdd(t, uvid, vid, {"!type": "userToPost"}))
+            out.append(EdgeAdd(t, vid, uvid, {"!type": "postToUser"}))
+        if topic is not None and topic.get("id") is not None:
+            tvid = assign_id(f"gab:topic:{topic['id']}")
+            out.append(VertexAdd(t, tvid, {
+                "created_at": s(topic.get("created_at")),
+                "category": s(topic.get("category")),
+                "title": s(topic.get("title")),
+                "!type": "topic",
+                "id": s(topic.get("id")),
+            }))
+            out.append(EdgeAdd(t, vid, tvid, {"!type": "postToTopic"}))
+        if parent_vid is not None:
+            out.append(EdgeAdd(t, vid, parent_vid,
+                               {"!type": "childToParent"}))
+        parent = post.get("parent")
+        if isinstance(parent, dict) and parent_vid is None:  # one level only
+            out.extend(self._unfold(parent, parent_vid=vid))
+        return out
 
 
 @dataclass(frozen=True)

@@ -282,3 +282,58 @@ def test_temporal_embeddings_nearest_and_drift():
     # they lost a member)
     d = {int(v): float(drift[i]) for i, v in enumerate(uv)}
     assert d[3] > d[1] and d[3] > 0.1
+
+
+def test_gab_raw_post_parser_unfolds_hetero_graph():
+    """One raw JSON post → post/user/topic vertices, the four typed edges,
+    and a single-level parent unfold (GabRawRouter.scala:28-130)."""
+    from raphtory_tpu.examples.gab import GabRawPostParser
+    from raphtory_tpu.ingestion.updates import assign_id
+
+    parent = {"id": 7, "created_at": "2016-08-10T12:00:00+00:00",
+              "user": {"id": 2, "name": "P", "username": "p",
+                       "verified": False},
+              "parent": {"id": 99, "created_at": "2016-08-10T11:00:00",
+                         "user": None}}
+    post = {"id": 5, "created_at": "2016-08-10 13:58:06", "score": 3,
+            "like_count": 4,
+            "user": {"id": 1, "name": "A", "username": "a",
+                     "verified": True},
+            "topic": {"id": "t1", "created_at": "2016-08-01",
+                      "title": "News", "category": 2},
+            "parent": parent}
+    updates = GabRawPostParser()(json.dumps(post))
+
+    vadds = [u for u in updates if isinstance(u, VertexAdd)]
+    eadds = [u for u in updates if isinstance(u, EdgeAdd)]
+    # post+user+topic for the child, post+user for the parent; the
+    # grandparent (depth 2) is NOT unfolded — one recursion per post
+    assert len(vadds) == 5
+    types = sorted(u.props["!type"] for u in eadds)
+    assert types == ["childToParent", "postToTopic", "postToUser",
+                     "postToUser", "userToPost", "userToPost"]
+    c2p = next(u for u in eadds if u.props["!type"] == "childToParent")
+    assert c2p.src == assign_id("gab:post:7")
+    assert c2p.dst == assign_id("gab:post:5")
+
+    # drives the pipeline end-to-end and the topic analyser sees the topic
+    pipe = IngestionPipeline()
+    pipe.add_source(IterableSource([json.dumps(post)], name="raw"),
+                    GabRawPostParser())
+    pipe.run()
+    assert not pipe.errors and pipe.counts["raw"] == len(updates)
+    g = TemporalGraph(pipe.log, pipe.watermarks)
+    v = g.view_at(1470837486)
+    assert v.n_active == 5
+    tprop = v.vertex_prop_str("type")
+    assert sorted(x for x in tprop if x) .count("post") == 2
+    assert "topic" in tprop and "user" in tprop
+
+    # malformed lines drop, not raise
+    assert GabRawPostParser()("not json") == []
+    assert GabRawPostParser()('{"id": null}') == []
+    ok = '"id": 1, "created_at": "2016-08-10 13:58:06"'
+    # truthy non-dict sub-objects are ignored, not fatal
+    assert len(GabRawPostParser()('{%s, "topic": "news"}' % ok)) == 1
+    assert len(GabRawPostParser()('{%s, "user": "bob"}' % ok)) == 1
+    assert len(GabRawPostParser()('{%s, "parent": [1]}' % ok)) == 1

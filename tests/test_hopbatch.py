@@ -301,13 +301,17 @@ def test_delta_fold_matches_host_columns(monkeypatch):
                                               HopBatchedPageRank)
 
     log = random_log(np.random.default_rng(11), n_events=900, n_ids=40,
-                     t_span=1000)   # includes deletes
+                     t_span=1000, props=True)   # deletes + weight props
     hops = [300, 500, 700, 900]
     windows = [250, None]
 
+    from raphtory_tpu.engine.hopbatch import HopBatchedSSSP
+
     for cls, kw in ((HopBatchedPageRank, dict(tol=0.0, max_steps=8)),
                     (HopBatchedCC, dict(max_steps=30)),
-                    (HopBatchedBFS, dict(seeds=(1, 2), max_steps=30))):
+                    (HopBatchedBFS, dict(seeds=(1, 2), max_steps=30)),
+                    (HopBatchedSSSP, dict(seeds=(1, 2), max_steps=30,
+                                          weight_prop="w"))):
         monkeypatch.setenv("RTPU_FOLD", "host")
         host, s1 = cls(log, **kw).run(hops, windows)
         monkeypatch.setenv("RTPU_FOLD", "delta")
