@@ -42,6 +42,20 @@ from .snapshot import (
 _ENC_SHIFT = 32
 _ENC_MASK = (1 << _ENC_SHIFT) - 1
 
+_VFOLD_POOL = None
+
+
+def _vfold_pool():
+    """Process-wide worker pool for the overlapped vertex folds — shared
+    so long-lived servers don't pin one idle thread per SweepBuilder."""
+    global _VFOLD_POOL
+    if _VFOLD_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _VFOLD_POOL = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="sweep-vfold")
+    return _VFOLD_POOL
+
 _EMPTY_DELTA = {
     "v_idx": np.empty(0, np.int64), "v_lat": np.empty(0, np.int64),
     "v_alive": np.empty(0, bool), "v_first": np.empty(0, np.int64),
@@ -138,6 +152,10 @@ class SweepBuilder:
         self._ea_rows = np.empty(0, np.int64)
         self._va_rows = np.empty(0, np.int64)
         self.t_prev: int | None = None
+        # per-hop row selection: binary search when the log is time-sorted
+        # (bulk loads, replayed dumps), O(N) boolean scan otherwise
+        self._t_sorted = bool(
+            len(self._t) == 0 or bool((self._t[:-1] <= self._t[1:]).all()))
         # last hop's touched-entity delta (dense vertex indices + packed edge
         # keys with their POST-update fold state) — consumed by the
         # device-resident sweep engine (engine/device_sweep.py), which ships
@@ -179,9 +197,15 @@ class SweepBuilder:
 
     def _advance(self, time: int) -> None:
         t_prev = self.t_prev if self.t_prev is not None else np.iinfo(np.int64).min
-        sel = (self._t <= time) if t_prev == np.iinfo(np.int64).min \
-            else ((self._t > t_prev) & (self._t <= time))
-        rows = np.flatnonzero(sel)
+        if self._t_sorted:
+            lo = 0 if t_prev == np.iinfo(np.int64).min \
+                else int(np.searchsorted(self._t, t_prev, side="right"))
+            hi = int(np.searchsorted(self._t, time, side="right"))
+            rows = np.arange(lo, hi)
+        else:
+            sel = (self._t <= time) if t_prev == np.iinfo(np.int64).min \
+                else ((self._t > t_prev) & (self._t <= time))
+            rows = np.flatnonzero(sel)
         self.t_prev = time
         if len(rows) == 0:
             self.last_delta = _EMPTY_DELTA
@@ -220,18 +244,28 @@ class SweepBuilder:
         t_del = t[is_vd]
 
         # -- vertex delta fold: adds + edge-endpoint revivals vs deletes --
+        # runs in a worker thread OVERLAPPED with the edge-side marks+fold
+        # below (independent state; ctypes/numpy release the GIL): the two
+        # folds are the per-hop host cost of a columnar sweep
         v_ids = np.concatenate([dv_add, ds_ea, dd_ea, dv_del])
         v_t = np.concatenate([t[is_va], t[is_ea], t[is_ea], t_del])
         v_al = np.zeros(len(v_ids), bool)
         v_al[: len(v_ids) - len(dv_del)] = True
-        if len(v_ids):
-            (uvd,), dlat, dalive, dfirst = _fold_latest((v_ids,), v_t, v_al)
+
+        def _vertex_fold():
+            if not len(v_ids):
+                return None
+            (uvd0,), dlat, dalive, dfirst = _fold_latest((v_ids,), v_t, v_al)
             # delta times are strictly later than any prior mark, so the
             # delta's latest wins outright and firsts only fill unseen slots
-            self.v_lat[uvd] = dlat
-            self.v_alive[uvd] = dalive
-            self.v_first[uvd] = np.where(self.v_seen[uvd], self.v_first[uvd], dfirst)
-            self.v_seen[uvd] = True
+            self.v_lat[uvd0] = dlat
+            self.v_alive[uvd0] = dalive
+            self.v_first[uvd0] = np.where(self.v_seen[uvd0],
+                                          self.v_first[uvd0], dfirst)
+            self.v_seen[uvd0] = True
+            return uvd0
+
+        v_fut = _vfold_pool().submit(_vertex_fold)
 
         # -- edge delta marks: own add/delete events --
         enc_ea = self._pack(ds_ea, dd_ea)
@@ -327,6 +361,8 @@ class SweepBuilder:
             order = np.argsort(self.dh_v, kind="stable")
             self.dh_v = self.dh_v[order]
             self.dh_t = self.dh_t[order]
+
+        uvd = v_fut.result()   # join the overlapped vertex fold
 
         # Touched-entity delta with POST-update fold state, read back from the
         # running arrays so it is correct no matter which code path (known

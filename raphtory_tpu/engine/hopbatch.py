@@ -819,11 +819,12 @@ class HopBatchedSSSP(HopBatchedBFS):
             hi = int(np.searchsorted(self._w_t, T, side="right"))
             pos = self._w_pos[self._w_cursor:hi].astype(np.int32)
             val = self._w_val[self._w_cursor:hi]
-            if len(pos):
+            if j > 0 and len(pos):
                 # last-wins per pair WITHIN the hop: XLA scatter order is
                 # undefined for duplicate indices, so the dedup must happen
                 # here (the host fold's sequential assignment is last-wins
-                # by construction)
+                # by construction). Hop 0's slice — the bulk of a cold
+                # sweep — folds into the base instead, no dedup needed.
                 u_last = np.unique(pos[::-1], return_index=True)[1]
                 sel = np.sort(len(pos) - 1 - u_last)
                 pos, val = pos[sel], val[sel]

@@ -10,12 +10,16 @@ from __future__ import annotations
 
 import ctypes
 import logging
+import threading
 
 import numpy as np
 
 _log = logging.getLogger(__name__)
 _lib = None
 _tried = False
+_load_lock = threading.Lock()   # first use may g++-build the library —
+# concurrent first callers (e.g. the sweep's overlapped vertex fold) must
+# not race the build/latch
 
 _i64p = ctypes.POINTER(ctypes.c_int64)
 _u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -25,7 +29,24 @@ def _load():
     global _lib, _tried
     if _tried:
         return _lib
-    _tried = True
+    with _load_lock:
+        if _tried:
+            return _lib
+        return _load_locked()
+
+
+def _load_locked():
+    global _lib, _tried
+    try:
+        _lib = _build_and_bind()
+    finally:
+        # set LAST (under the lock, after _lib publishes) so the unlocked
+        # fast path never observes _tried before _lib
+        _tried = True
+    return _lib
+
+
+def _build_and_bind():
     from .build import lib_path
 
     path = lib_path()
@@ -64,8 +85,7 @@ def _load():
     lib.rtpu_searchsorted_u64.restype = None
     lib.rtpu_searchsorted_u64.argtypes = [
         ctypes.c_int64, _u64p, ctypes.c_int64, _u64p, ctypes.c_int32, _i64p]
-    _lib = lib
-    return _lib
+    return lib
 
 
 def available() -> bool:
