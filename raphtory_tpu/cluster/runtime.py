@@ -42,8 +42,9 @@ class NodeRuntime:
 
                 restored = load_log(p)
         self.graph = TemporalGraph(restored)
-        self.pipeline = IngestionPipeline(log=self.graph.log,
-                                          watermarks=self.graph.watermarks)
+        self.pipeline = IngestionPipeline(
+            log=self.graph.log, watermarks=self.graph.watermarks,
+            queue_max_events=self.settings.ingest_queue_events)
         self.mesh = mesh
         self.manager = AnalysisManager(
             self.graph, mesh=mesh, sink_dir=self.settings.sink_dir,

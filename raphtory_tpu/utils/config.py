@@ -74,6 +74,10 @@ class Settings:
     sink_dir: str = ""
     sink_format: str = "jsonl"   # default per-job format: jsonl | csv
 
+    # staged ingestion: >0 bounds a parse→append queue (events) with a
+    # backlog gauge — the writer-mailbox shape; 0 = direct appends
+    ingest_queue_events: int = 0
+
     @classmethod
     def from_env(cls, prefix: str = "RAPHTORY_TPU_") -> "Settings":
         kw = {}

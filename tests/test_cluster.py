@@ -161,3 +161,26 @@ def test_scheduler_cancel_during_long_tick_sticks():
     time.sleep(0.5)
     assert len(hits) == 1  # the running tick must NOT re-arm itself
     sch.shutdown()
+
+
+def test_node_runtime_staged_ingestion_setting():
+    """ingest_queue_events>0 routes node ingestion through the staged
+    queue (backlog gauge path) and drains fully."""
+    import numpy as np
+
+    from raphtory_tpu.cluster.runtime import NodeRuntime
+    from raphtory_tpu.ingestion.source import IterableSource
+    from raphtory_tpu.ingestion.updates import EdgeAdd
+    from raphtory_tpu.utils.config import Settings
+
+    node = NodeRuntime(settings=Settings(
+        ingest_queue_events=2048, archiving=False, compressing=False))
+    assert node.pipeline.staged
+    ups = [EdgeAdd(int(t), int(t) % 10, (int(t) + 1) % 10)
+           for t in range(3000)]
+    node.add_source(IterableSource(ups, name="s"))
+    node.ingest(wait=True)
+    assert not node.pipeline.errors
+    assert node.pipeline.backlog() == 0
+    assert node.graph.log.n == 3000
+    node.stop()

@@ -1,12 +1,18 @@
 #!/bin/bash
-# Probe the tunnelled TPU every ~4 minutes; log state transitions.
+# Probe the tunnelled TPU every ~4 minutes; on revival, run the round-5
+# validation queue (tools/tpu_validate.sh) automatically, then keep
+# probing (the tunnel can die again; validate is idempotent).
 LOG=/tmp/tpu_probe.log
 echo "$(date -u +%H:%M:%S) probe loop start" >> $LOG
 while true; do
-  if timeout 90 /opt/venv/bin/python -c "import jax; d=jax.devices(); assert d and d[0].platform!='cpu', d; print(d)" >> $LOG 2>&1; then
+  if timeout 100 /opt/venv/bin/python -c "import jax; d=jax.devices(); assert d and d[0].platform!='cpu', d; print(d)" >> $LOG 2>&1; then
     echo "$(date -u +%H:%M:%S) TPU ALIVE" >> $LOG
     touch /tmp/tpu_alive
-    exit 0
+    /root/repo/tools/tpu_validate.sh >> $LOG 2>&1
+    if [ -f /tmp/tpu_validated ]; then
+      echo "$(date -u +%H:%M:%S) validation complete; probe loop exiting" >> $LOG
+      exit 0
+    fi
   else
     echo "$(date -u +%H:%M:%S) tpu down" >> $LOG
   fi
