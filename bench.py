@@ -33,10 +33,6 @@ import traceback
 
 import numpy as np
 
-class _SkipColumnar(Exception):
-    """Deliberate engine skip (e.g. CPU backend) — not a failure."""
-
-
 REF_VIEW_S = 12.056          # README GAB CC Range per-view viewTime
 REF_INGEST_1PM = 27_000.0    # paper §6.1, 1 partition manager, in-memory
 REF_INGEST_8PM = 62_000.0    # paper §6.1, 8 partition managers
@@ -281,6 +277,17 @@ def _gab_log():
     return gab_like_log(n_vertices=30_000, n_edges=300_000, t_span=_GAB_SPAN)
 
 
+def _chunks(default: int, name: str = "") -> int:
+    """Pipeline depth for the columnar sweeps. Per-config override
+    RTPU_CHUNKS_<NAME> beats the global RTPU_CHUNKS beats the default —
+    the host-side tradeoff moved when the delta fold landed, and the
+    device-side one is tuned on hardware without recompiling configs."""
+    v = os.environ.get(f"RTPU_CHUNKS_{name}") if name else None
+    if v is None:
+        v = os.environ.get("RTPU_CHUNKS", default)
+    return max(1, int(v))
+
+
 def bench_headline():
     """North star: windowed PageRank Range query, GAB-scale graph.
 
@@ -302,7 +309,7 @@ def bench_headline():
     # pipeline: fold chunk k+1 on host while k runs on device. 3 measured
     # best on host now that the delta fold made the host side cheap;
     # RTPU_CHUNKS overrides for on-device tuning.
-    n_chunks = int(os.environ.get("RTPU_CHUNKS", "3"))
+    n_chunks = _chunks(3, "PR")
     try:
         warm = HopBatchedPageRank(log, tol=1e-7, max_steps=20)
         _sync(warm.run(hops, windows, chunks=n_chunks,
@@ -356,28 +363,23 @@ def bench_gab_cc_range():
     """The actual README datapoint shape: ConnectedComponents Range query
     over the GAB graph, one 1-month window per view (viewTime 12,056 ms).
     Engine: columnar min-label propagation, whole sweep in one dispatch."""
-    import jax
-
     t_span = _GAB_SPAN
     log = _gab_log()
     view_times = np.linspace(0.45 * t_span, t_span, 12).astype(np.int64)
     windows = [2_600_000]
-    # single-column sweeps don't amortise enough to beat the per-hop
-    # scalar path on the (1-core) CPU backend — only device backends batch
-    use_columnar = jax.default_backend() != "cpu"
+    # the delta fold made the columnar sweep the fastest path on every
+    # backend (CPU included: 32 vs 14 views/s measured host-side)
     try:
-        if not use_columnar:
-            raise _SkipColumnar
         from raphtory_tpu.engine.hopbatch import HopBatchedCC
 
         hops = [int(T) for T in view_times]
         warm = HopBatchedCC(log, max_steps=50)
-        _sync(warm.run(hops, windows, chunks=4)[0])
+        _sync(warm.run(hops, windows, chunks=_chunks(1, "CC"))[0])
         del warm
 
         def once():
             hb = HopBatchedCC(log, max_steps=50)
-            labels, steps = hb.run(hops, windows, chunks=4)
+            labels, steps = hb.run(hops, windows, chunks=_chunks(1, "CC"))
             return labels, {"steps": int(steps)}
 
         elapsed, repeats, aux = _best_of(once)
@@ -396,8 +398,7 @@ def bench_gab_cc_range():
 
         vps, detail = _range_sweep(
             ConnectedComponents(max_steps=50), log, view_times, windows)
-        if not isinstance(e, _SkipColumnar):  # a skip is not a failure
-            detail["hopbatch_error"] = f"{type(e).__name__}: {e}"[:300]
+        detail["hopbatch_error"] = f"{type(e).__name__}: {e}"[:300]
     detail["baseline"] = "README GAB CC Range viewTime 12.056s, 1-month window"
     return {
         "metric": "GAB ConnectedComponents Range views/sec (1-month window)",
@@ -480,11 +481,9 @@ def bench_bitcoin_range():
 
 def bench_ldbc_traversal():
     """LDBC-SNB-shaped BFS + weighted SSSP over sliding windows (with
-    deletions): both traversals run per view, combined views/sec. On device
-    backends BFS batches the whole sweep into one columnar dispatch;
-    SSSP (edge-weight property) takes the host snapshot path."""
-    import jax
-
+    deletions): both traversals batch their whole sweep into columnar
+    dispatches (weights fold as base+deltas too), combined views/sec;
+    either half falls back to the per-view snapshot path alone."""
     from raphtory_tpu.algorithms import BFS, SSSP
     from raphtory_tpu.utils.synth import ldbc_like_log
 
@@ -498,39 +497,41 @@ def bench_ldbc_traversal():
     sssp = SSSP(seeds=seeds, weight_prop="weight", directed=False,
                 max_steps=32)
     parts = _ldbc_err = None
-    if jax.default_backend() != "cpu":
-        # columnar halves: only the hopbatch paths are inside the try, so
-        # a failure elsewhere is neither mislabelled nor re-run as fallback
-        try:
-            from raphtory_tpu.engine.hopbatch import (HopBatchedBFS,
-                                                      HopBatchedSSSP)
+    # columnar is fastest on every backend since the delta fold; only the
+    # hopbatch paths are inside the try, so a failure elsewhere is neither
+    # mislabelled nor re-run as fallback
+    try:
+        from raphtory_tpu.engine.hopbatch import (HopBatchedBFS,
+                                                  HopBatchedSSSP)
 
-            hops = [int(T) for T in view_times]
+        hops = [int(T) for T in view_times]
 
-            def make(kind):
-                if kind == "bfs":
-                    return HopBatchedBFS(log, seeds, directed=False,
-                                         max_steps=32)
-                return HopBatchedSSSP(log, seeds, "weight", directed=False,
-                                      max_steps=32)
+        def make(kind):
+            if kind == "bfs":
+                return HopBatchedBFS(log, seeds, directed=False,
+                                     max_steps=32)
+            return HopBatchedSSSP(log, seeds, "weight", directed=False,
+                                  max_steps=32)
 
-            parts = {}
-            for kind in ("bfs", "sssp"):
-                # per-half try: one half failing falls back alone instead
-                # of discarding the other's completed columnar sweep
-                try:
-                    _sync(make(kind).run(hops, windows, chunks=5)[0])
+        parts = {}
+        for kind in ("bfs", "sssp"):
+            # per-half try: one half failing falls back alone instead
+            # of discarding the other's completed columnar sweep
+            try:
+                _sync(make(kind).run(hops, windows,
+                                     chunks=_chunks(1, "TRAV"))[0])
 
-                    def once(kind=kind):
-                        return make(kind).run(hops, windows, chunks=5)[0], {}
+                def once(kind=kind):
+                    return make(kind).run(
+                        hops, windows, chunks=_chunks(1, "TRAV"))[0], {}
 
-                    secs, reps, _aux = _best_of(once)
-                    parts[kind] = (secs, reps)
-                except Exception as e:
-                    _ldbc_err = f"{kind}: {type(e).__name__}: {e}"[:300]
-        except Exception as e:   # import/setup failure: no columnar halves
-            parts = {}
-            _ldbc_err = f"{type(e).__name__}: {e}"[:300]
+                secs, reps, _aux = _best_of(once)
+                parts[kind] = (secs, reps)
+            except Exception as e:
+                _ldbc_err = f"{kind}: {type(e).__name__}: {e}"[:300]
+    except Exception as e:   # import/setup failure: no columnar halves
+        parts = {}
+        _ldbc_err = f"{type(e).__name__}: {e}"[:300]
     parts = parts or {}
     n_views = secs = 0.0
     detail = {}

@@ -480,6 +480,22 @@ class _HopBatched:
             return False
         return os.environ.get("RTPU_FOLD", "delta") != "host"
 
+    def host_column_bytes(self, n_hops: int) -> int:
+        """Host bytes the fold will materialise for an ``n_hops`` sweep —
+        O(base) on the delta path, O(H · (m_pad + n_pad)) on the
+        host-column path. Routing layers size their admission guards from
+        THIS, not from engine internals."""
+        t = self.tables
+        per_row = np.dtype(t.tdtype).itemsize + 1   # lat + alive
+        if self._use_delta_fold():
+            return (t.m_pad + t.n_pad) * per_row
+        return n_hops * (t.m_pad + t.n_pad) * per_row
+
+    def device_mask_bytes(self, n_cols: int) -> int:
+        """Device bytes of the [m_pad+n_pad, C] bool masks every columnar
+        kernel holds across its superstep loop."""
+        return (self.tables.m_pad + self.tables.n_pad) * n_cols
+
     def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
         raise NotImplementedError
 

@@ -271,11 +271,15 @@ class Job:
             _jobs_log.info("columnar range route declined: %s: %s",
                            type(e).__name__, e)
             return None
-        # columnar state is O(hops * (m_pad + n_pad)) on host — big graphs
-        # with long ranges stay on the O(1)-memory-per-hop paths instead
-        # (which rebuild their own tables; a rejected range pays the table
-        # build twice, acceptable next to the sweep it avoids misrouting)
-        if len(hops) * (hb.tables.m_pad + hb.tables.n_pad) > 1 << 28:
+        # memory guards, sized by the ENGINE's own accounting (the fold
+        # strategy — delta vs host columns — changes what the host
+        # materialises). Oversized ranges stay on the O(1)-memory-per-hop
+        # paths (which rebuild their own tables; a rejected range pays
+        # the table build twice, acceptable next to the sweep it avoids
+        # misrouting).
+        if hb.device_mask_bytes(len(hops) * len(windows)) > 1 << 32:
+            return None
+        if hb.host_column_bytes(len(hops)) > 1 << 29:
             return None
         return hops, windows, hb
 
