@@ -90,6 +90,22 @@ def _masks_from_deltas(tdt, H: int, W: int,
     return me, mv
 
 
+def _edge_tile_for(m_pad: int, C: int, budget_bytes: int = 1 << 28) -> int | None:
+    """Edge-tile length for the columnar kernels, or None for single-shot.
+
+    The per-iteration payload ``[m_pad, C] f32`` is the scale limiter: at
+    28M pairs x 128 columns it is ~14 GB — over a v5e's HBM — and the
+    resulting spill is catastrophic. When the payload would exceed
+    ``budget_bytes``, the edge dimension is processed as a ``lax.scan``
+    over equal tiles (plus one remainder slice, so no divisibility
+    gymnastics) whose transient is ``tile * C * 4`` bytes."""
+    if m_pad * C * 4 <= budget_bytes or m_pad <= (1 << 16):
+        return None
+    step = 1 << 16
+    target = max(step, budget_bytes // (C * 4))
+    return min((target // step) * step, m_pad)
+
+
 def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
                       tol: float, max_steps: int, r_init=None):
     """Power iteration over per-column masks ``me [m_pad, C]`` /
@@ -105,12 +121,48 @@ def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
     to its own alive set, floored so newly-alive vertices get mass, and
     renormalised."""
     C = me.shape[1]
-    # out-degree per column: combine at src (unsorted scatter, once). The
-    # f32 view of the mask is TRANSIENT (fused into the scatter-add): a
-    # materialised [m_pad, C] f32 mask is 4x the bool one and at
-    # 33M x 128 columns it alone would exhaust a v5e's HBM
-    out_deg = jax.ops.segment_sum(me.astype(jnp.float32), e_src,
-                                  num_segments=n_pad)
+    # Edge traffic is tiled past the payload budget (_edge_tile_for): the
+    # f32 view of the mask and the per-iteration gather payload are both
+    # [m_pad, C] transients that at 28M pairs x 128 columns outgrow a
+    # v5e's HBM — the resulting spill, not compute, bound the scale sweep.
+    tile = _edge_tile_for(e_src.shape[0], C)
+    if tile is not None:
+        n_main = (e_src.shape[0] // tile) * tile
+        main = (e_src[:n_main].reshape(-1, tile),
+                e_dst[:n_main].reshape(-1, tile),
+                me[:n_main].reshape(-1, tile, C))
+        rem = (e_src[n_main:], e_dst[n_main:], me[n_main:])
+        # carry seed rides the mask's varying axes: under the
+        # column-sharded shard_map(check_vma=True) the accumulator must
+        # enter the scan column-varying, like the while_loop seeds below
+        acc0 = (jnp.zeros((n_pad, C), jnp.float32)
+                + (mv[0] & False).astype(jnp.float32)[None, :])
+
+        def tiled_sum(payload_of, by_dst):
+            def step(acc, inp):
+                es, ed, mk = inp
+                return acc + jax.ops.segment_sum(
+                    payload_of(es, mk), ed if by_dst else es,
+                    num_segments=n_pad,
+                    # tiles are contiguous slices of the globally
+                    # (dst, src)-sorted order
+                    indices_are_sorted=by_dst), None
+
+            acc, _ = jax.lax.scan(step, acc0, main)
+            if rem[0].shape[0]:
+                es, ed, mk = rem
+                acc = acc + jax.ops.segment_sum(
+                    payload_of(es, mk), ed if by_dst else es,
+                    num_segments=n_pad, indices_are_sorted=by_dst)
+            return acc
+
+        out_deg = tiled_sum(
+            lambda es, mk: mk.astype(jnp.float32), by_dst=False)
+    else:
+        # out-degree per column: combine at src (unsorted scatter, once);
+        # the f32 view of the mask fuses into the scatter-add
+        out_deg = jax.ops.segment_sum(me.astype(jnp.float32), e_src,
+                                      num_segments=n_pad)
     n_act = jnp.maximum(jnp.sum(mv.astype(jnp.float32), axis=0), 1.0)
     r0 = jnp.where(mv, 1.0 / n_act[None, :], 0.0).astype(jnp.float32)
     if r_init is not None:
@@ -124,11 +176,16 @@ def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
 
     def body(carry):
         step, r, halted = carry
-        # row gather [m, C]; the bool mask gates via where — only the bool
-        # mask stays live across the loop
-        payload = jnp.where(me, (r * inv_deg)[e_src, :], 0.0)
-        agg = jax.ops.segment_sum(
-            payload, e_dst, num_segments=n_pad, indices_are_sorted=True)
+        rd = r * inv_deg
+        if tile is not None:
+            agg = tiled_sum(
+                lambda es, mk: jnp.where(mk, rd[es, :], 0.0), by_dst=True)
+        else:
+            # row gather [m, C]; the bool mask gates via where — only the
+            # bool mask stays live across the loop
+            payload = jnp.where(me, rd[e_src, :], 0.0)
+            agg = jax.ops.segment_sum(
+                payload, e_dst, num_segments=n_pad, indices_are_sorted=True)
         dangling = jnp.sum(jnp.where(dangling_mask, r, 0.0), axis=0)
         new = ((1.0 - damping) / n_act[None, :]
                + damping * (agg + dangling[None, :] / n_act[None, :]))

@@ -359,3 +359,44 @@ def test_fold_mode_toggle_keeps_delta_base_fresh(monkeypatch):
     ref, _ = HopBatchedPageRank(ref_log, tol=0.0, max_steps=8).run(
         [500, 600], [None])
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_edge_tiled_pagerank_matches_single_shot(monkeypatch):
+    """Forcing the edge-tile path (tiny payload budget) matches the
+    single-shot kernel to f32 reassociation tolerance — and provably
+    TOOK the tiled path (m_pad must exceed the 2^16 single-shot floor)."""
+    import numpy as np
+
+    from raphtory_tpu.engine import hopbatch as hb_mod
+    from raphtory_tpu.engine.hopbatch import HopBatchedPageRank
+
+    # >2^16 distinct pairs so the tile floor doesn't bypass tiling
+    log = random_log(np.random.default_rng(21), n_events=180_000,
+                     n_ids=2_000, t_span=5_000)
+    hops = [2_000, 3_500, 5_000]
+    windows = [2_500, None]
+    hb1 = HopBatchedPageRank(log, tol=0.0, max_steps=8)
+    assert hb1.tables.m_pad > (1 << 16)
+    one, s1 = hb1.run(hops, windows)
+    one = np.asarray(one)
+
+    orig = hb_mod._edge_tile_for
+    used = []
+
+    def tiny_budget(m_pad, C, budget_bytes=1 << 28):
+        t = orig(m_pad, C, budget_bytes=1 << 18)
+        used.append(t)
+        return t
+
+    monkeypatch.setattr(hb_mod, "_edge_tile_for", tiny_budget)
+    hb_mod._compiled.cache_clear()
+    hb_mod._compiled_delta.cache_clear()
+    try:
+        tiled, s2 = HopBatchedPageRank(log, tol=0.0, max_steps=8).run(
+            hops, windows)
+        assert used and used[-1] is not None   # the tiled path really ran
+        np.testing.assert_allclose(one, np.asarray(tiled), atol=1e-6)
+        assert int(s1) == int(s2)
+    finally:
+        hb_mod._compiled.cache_clear()
+        hb_mod._compiled_delta.cache_clear()
