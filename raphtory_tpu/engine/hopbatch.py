@@ -331,6 +331,40 @@ def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
         *(jnp.asarray(a) for a in extra))
 
 
+def _edge_accumulate(seg, payload_of, combine, init, e_from, e_to, me, ew,
+                     n_pad: int, tile, sorted_: bool):
+    """``combine(acc, seg(payload_of(ef, mk, ex), et))`` over the edge
+    dimension — single-shot when ``tile`` is None, else a ``lax.scan``
+    over equal tiles plus a remainder slice (transient bounded at
+    tile*C). ``ew`` is an optional per-edge [m_pad, C] operand (weighted
+    traversal), sliced alongside. ``init`` must carry the vma the caller's
+    loop state carries (see the while_loop seeds)."""
+    C = me.shape[1]
+
+    def one(ef, et, mk, ex):
+        return seg(payload_of(ef, mk, ex), et, num_segments=n_pad,
+                   indices_are_sorted=sorted_)
+
+    if tile is None:
+        return combine(init, one(e_from, e_to, me, ew))
+    n_main = (e_from.shape[0] // tile) * tile
+    xs = (e_from[:n_main].reshape(-1, tile),
+          e_to[:n_main].reshape(-1, tile),
+          me[:n_main].reshape(-1, tile, C)) + (
+        (ew[:n_main].reshape(-1, tile, C),) if ew is not None else ())
+
+    def step(acc, inp):
+        ef, et, mk = inp[:3]
+        ex = inp[3] if len(inp) > 3 else None
+        return combine(acc, one(ef, et, mk, ex)), None
+
+    acc, _ = jax.lax.scan(step, init, xs)
+    if n_main < e_from.shape[0]:
+        acc = combine(acc, one(e_from[n_main:], e_to[n_main:], me[n_main:],
+                               ew[n_main:] if ew is not None else None))
+    return acc
+
+
 def _cc_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int):
     """Columnar min-label propagation — connected components for every
     (hop, window) column at once (semantics of
@@ -340,15 +374,19 @@ def _cc_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int):
     I32_MAX = jnp.iinfo(jnp.int32).max
     lab0 = jnp.where(mv, jnp.arange(n_pad, dtype=jnp.int32)[:, None],
                      I32_MAX)
+    tile = _edge_tile_for(e_src.shape[0], me.shape[1])
+    max0 = jnp.full_like(lab0, I32_MAX) \
+        + (mv[0] & False).astype(jnp.int32)[None, :]   # vma-seeded
 
     def body(carry):
         step, lab, halted = carry
 
         def pull(idx_from, idx_to, sorted_):
-            payload = jnp.where(me, lab[idx_from, :], I32_MAX)
-            return jax.ops.segment_min(
-                payload, idx_to, num_segments=n_pad,
-                indices_are_sorted=sorted_)
+            return _edge_accumulate(
+                jax.ops.segment_min,
+                lambda ef, mk, _: jnp.where(mk, lab[ef, :], I32_MAX),
+                jnp.minimum, max0, idx_from, idx_to, me, None,
+                n_pad, tile, sorted_)
 
         agg = jnp.minimum(pull(e_src, e_dst, True),
                           pull(e_dst, e_src, False))
@@ -390,15 +428,21 @@ def _bfs_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
     Shared by the single-device kernel and the column-sharded runner."""
     INF = jnp.float32(jnp.inf)
     d0 = jnp.where(mv & seed_mask[:, None], 0.0, INF)
+    tile = _edge_tile_for(e_src.shape[0], me.shape[1])
+    ew_arr = None if not hasattr(ew, "shape") or ew.ndim == 0 else ew
+    inf0 = jnp.full_like(d0, INF) \
+        + (mv[0] & False).astype(jnp.float32)[None, :]   # vma-seeded
 
     def body(carry):
         step, dist, halted = carry
 
         def pull(idx_from, idx_to, sorted_):
-            payload = jnp.where(me, dist[idx_from, :] + ew, INF)
-            return jax.ops.segment_min(
-                payload, idx_to, num_segments=n_pad,
-                indices_are_sorted=sorted_)
+            return _edge_accumulate(
+                jax.ops.segment_min,
+                lambda ef, mk, ex: jnp.where(
+                    mk, dist[ef, :] + (ew if ex is None else ex), INF),
+                jnp.minimum, inf0, idx_from, idx_to, me, ew_arr,
+                n_pad, tile, sorted_)
 
         agg = pull(e_src, e_dst, True)
         if not directed:

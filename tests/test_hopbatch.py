@@ -372,7 +372,7 @@ def test_edge_tiled_pagerank_matches_single_shot(monkeypatch):
 
     # >2^16 distinct pairs so the tile floor doesn't bypass tiling
     log = random_log(np.random.default_rng(21), n_events=180_000,
-                     n_ids=2_000, t_span=5_000)
+                     n_ids=2_000, t_span=5_000, props=True)
     hops = [2_000, 3_500, 5_000]
     windows = [2_500, None]
     hb1 = HopBatchedPageRank(log, tol=0.0, max_steps=8)
@@ -388,15 +388,36 @@ def test_edge_tiled_pagerank_matches_single_shot(monkeypatch):
         used.append(t)
         return t
 
+    from raphtory_tpu.engine.hopbatch import (HopBatchedBFS, HopBatchedCC,
+                                              HopBatchedSSSP)
+
+    cc_one, _ = HopBatchedCC(log, max_steps=30).run(hops, windows)
+    bfs_one, _ = HopBatchedBFS(log, (1, 2), max_steps=30).run(hops, windows)
+    sssp_one, _ = HopBatchedSSSP(log, (1, 2), "w", max_steps=30).run(
+        hops, windows)
+
     monkeypatch.setattr(hb_mod, "_edge_tile_for", tiny_budget)
-    hb_mod._compiled.cache_clear()
-    hb_mod._compiled_delta.cache_clear()
+    for c in (hb_mod._compiled, hb_mod._compiled_delta, hb_mod._compiled_cc,
+              hb_mod._compiled_bfs):
+        c.cache_clear()
     try:
         tiled, s2 = HopBatchedPageRank(log, tol=0.0, max_steps=8).run(
             hops, windows)
         assert used and used[-1] is not None   # the tiled path really ran
         np.testing.assert_allclose(one, np.asarray(tiled), atol=1e-6)
         assert int(s1) == int(s2)
+        # min-combine kernels tile exactly (no reassociation concern)
+        cc_t, _ = HopBatchedCC(log, max_steps=30).run(hops, windows)
+        np.testing.assert_array_equal(np.asarray(cc_one), np.asarray(cc_t))
+        bfs_t, _ = HopBatchedBFS(log, (1, 2), max_steps=30).run(
+            hops, windows)
+        np.testing.assert_array_equal(np.asarray(bfs_one),
+                                      np.asarray(bfs_t))
+        sssp_t, _ = HopBatchedSSSP(log, (1, 2), "w", max_steps=30).run(
+            hops, windows)
+        np.testing.assert_array_equal(np.asarray(sssp_one),
+                                      np.asarray(sssp_t))
     finally:
-        hb_mod._compiled.cache_clear()
-        hb_mod._compiled_delta.cache_clear()
+        for c in (hb_mod._compiled, hb_mod._compiled_delta,
+                  hb_mod._compiled_cc, hb_mod._compiled_bfs):
+            c.cache_clear()
