@@ -618,7 +618,7 @@ def bench_ingest_sustained():
     from raphtory_tpu.ingestion.source import RandomSource, RateLimited
 
     queue_max = 200_000
-    r0, step, interval = 50_000.0, 50_000.0, 1.0
+    r0, step, interval = 75_000.0, 25_000.0, 1.0
     n_events = 8_000_000   # enough stream to outlast the ramp
     src = RateLimited(RandomSource(n_events, id_pool=1_000_000, seed=1),
                       rate=r0, ramp_step=step, ramp_interval_s=interval)

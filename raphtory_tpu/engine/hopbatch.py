@@ -570,8 +570,8 @@ class _HopBatched:
     supports_warm_start = False
 
     #: subclasses whose kernel has a delta-fed variant (device-side mask
-    #: rebuild, ``_masks_from_deltas``) — SSSP's weight columns are
-    #: host-folded, so it stays on the host-column path
+    #: rebuild, ``_masks_from_deltas``; SSSP additionally rebuilds its
+    #: weight state from base + per-hop deltas)
     supports_delta_fold = False
 
     def _use_delta_fold(self) -> bool:
@@ -865,6 +865,18 @@ class HopBatchedSSSP(HopBatchedBFS):
     (earliest-wins) are refused — the ascending fold is last-wins."""
 
     supports_delta_fold = True   # weights rebuild on device too
+
+    def host_column_bytes(self, n_hops: int) -> int:
+        extra = self.tables.m_pad * 4   # weight base (delta path)
+        if not self._use_delta_fold():
+            extra = n_hops * self.tables.m_pad * 4   # [H, m_pad] f32 cols
+        return super().host_column_bytes(n_hops) + extra
+
+    def device_mask_bytes(self, n_cols: int) -> int:
+        # the kernel holds a persistent [m_pad, C] f32 ew next to the
+        # bool masks — 4 extra bytes per (pair, column)
+        return (super().device_mask_bytes(n_cols)
+                + self.tables.m_pad * n_cols * 4)
 
     def __init__(self, log: EventLog, seeds, weight_prop: str,
                  directed: bool = False, max_steps: int = 100):

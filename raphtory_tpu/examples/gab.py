@@ -83,7 +83,11 @@ class GabRawPostParser(Parser):
     * the topic becomes a ``type=topic`` vertex (id/title/category/
       created_at props) with a ``postToTopic`` edge;
     * a quoted/replied parent post unfolds ONE level (the reference's
-      single-recursion guard) plus a ``childToParent`` edge.
+      single-recursion guard) plus a ``childToParent`` edge — emitted
+      at the CHILD's timestamp, child→parent (deliberate deviation: the
+      reference stamps it with the parent's earlier time and inverted
+      endpoints, ``GabRawRouter.scala:118-121``, which makes the child
+      vertex exist before it was posted).
 
     Ids are namespaced blake2b hashes (``assign_id``) instead of the
     reference's clash-prone ``"user".hashCode + id`` / ``2^24 + hash``
@@ -97,12 +101,14 @@ class GabRawPostParser(Parser):
             post = _json.loads(raw)
             if not isinstance(post, dict):
                 return []
-            return self._unfold(post, parent_vid=None)
+            return self._unfold(post, child=None)
         except (ValueError, KeyError, TypeError, OverflowError,
                 AttributeError):
             return []   # "Could not parse post"
 
-    def _unfold(self, post: dict, parent_vid):
+    def _unfold(self, post: dict, child: tuple | None):
+        """``child``: (child_vid, child_time) when this dict is a parent
+        being unfolded from its reply."""
         t = _epoch(str(post["created_at"])[:19])
         vid = assign_id(f"gab:post:{int(post['id'])}")
         user = post.get("user")
@@ -141,12 +147,13 @@ class GabRawPostParser(Parser):
                 "id": s(topic.get("id")),
             }))
             out.append(EdgeAdd(t, vid, tvid, {"!type": "postToTopic"}))
-        if parent_vid is not None:
-            out.append(EdgeAdd(t, vid, parent_vid,
+        if child is not None:
+            child_vid, child_t = child
+            out.append(EdgeAdd(child_t, child_vid, vid,
                                {"!type": "childToParent"}))
         parent = post.get("parent")
-        if isinstance(parent, dict) and parent_vid is None:  # one level only
-            out.extend(self._unfold(parent, parent_vid=vid))
+        if isinstance(parent, dict) and child is None:   # one level only
+            out.extend(self._unfold(parent, child=(vid, t)))
         return out
 
 

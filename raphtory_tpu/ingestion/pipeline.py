@@ -176,11 +176,15 @@ class IngestionPipeline:
             raise RuntimeError(f"ingest writer failed for source {name!r} "
                                f"(see pipeline.errors)")
         with self._q_cv:
-            if self._q_done:
-                return   # writer retired (post-stop zombie source): drop
-            while (self._q_events + len(t) > self.queue_max_events
+            while (not self._q_done
+                   and self._q_events + len(t) > self.queue_max_events
                    and self._q_events > 0 and not self._stop.is_set()):
                 self._q_cv.wait(0.1)   # backpressure: block, don't grow
+            if self._q_done:
+                # writer retired (post-stop zombie source, or it retired
+                # WHILE we were blocked above): drop rather than strand
+                # events on a queue nothing will ever drain
+                return
             self._q.append(("batch", name, (t, k, s, d, props), wm))
             self._q_events += len(t)
             METRICS.ingest_backlog.set(self._q_events)

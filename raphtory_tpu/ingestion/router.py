@@ -196,26 +196,31 @@ def merge_logs(logs: list[EventLog]) -> EventLog:
     d = np.concatenate([c[3] for c in cols]) if cols else np.empty(0, np.int64)
     order = np.lexsort((d, s, k, t))
     merged = EventLog()
-    # gather property rows keyed by ORIGINAL (log, event row) before the sort
+    # gather property rows keyed by ORIGINAL (log, event row) before the
+    # sort — vectorised per log: hoist the columns once, map key ids to
+    # (possibly "!"-marked) names once, and only materialise per-row
+    # Python objects for rows that actually carry properties
+    inv = np.empty(len(order), np.int64)
+    inv[order] = np.arange(len(order))
     props_at: dict[int, dict] = {}
     base = 0
     for lg in logs:
         pr = lg.props
-        ev_col = pr.column("event")
-        for j in range(len(ev_col)):
-            row = base + int(ev_col[j])
-            kid = int(pr.column("key")[j])
-            name = pr.key_name(kid)
-            if pr.is_immutable(kid):
-                name = "!" + name   # keep the immutability mark (events.py)
-            tag = int(pr.column("tag")[j])
-            val = (pr.string(int(pr.column("sref")[j])) if tag == 1
-                   else float(pr.column("num")[j]))
-            props_at.setdefault(row, {})[name] = val
+        ev_col = np.asarray(pr.column("event"), np.int64)
+        if len(ev_col):
+            kids = np.asarray(pr.column("key"))
+            tags = np.asarray(pr.column("tag"))
+            nums = np.asarray(pr.column("num"))
+            srefs = np.asarray(pr.column("sref"))
+            names = [("!" if pr.is_immutable(kid) else "") + pr.key_name(kid)
+                     for kid in range(len(pr.keys))]
+            rows = inv[base + ev_col]
+            for j in range(len(rows)):
+                val = (pr.string(int(srefs[j])) if tags[j] == pr.STR_TAG
+                       else float(nums[j]))
+                props_at.setdefault(int(rows[j]), {})[names[kids[j]]] = val
         base += lg.n
-    inv = np.empty(len(order), np.int64)
-    inv[order] = np.arange(len(order))
-    batch_props = [(int(inv[row]), p) for row, p in props_at.items()] or None
+    batch_props = sorted(props_at.items()) or None
     merged.append_batch(t[order], k[order], s[order], d[order],
                         props=batch_props)
     return merged

@@ -115,15 +115,30 @@ class RateLimited(Source):
         self.disorder = inner.disorder
 
     def __iter__(self):
+        # token bucket integrated over the RAMP: budget accrues at the
+        # rate in effect during each elapsed slice. The naive
+        # ``sent/rate(now) vs elapsed`` check would retroactively apply
+        # the ramped-up rate to the whole elapsed time, letting the
+        # source burst ~2x nominal right after every ramp step — which
+        # silently broke the saturation oracle built on offered rates.
         rate = self.rate
-        t0 = _time.monotonic()
+        t0 = last = _time.monotonic()
         sent = 0
+        allowed = 0.0
         for item in self.inner:
             yield item
             sent += 1
-            now = _time.monotonic()
-            if self.ramp_step:
-                rate = self.rate + self.ramp_step * int((now - t0) / self.ramp_interval_s)
-            ahead = sent / rate - (now - t0)
-            if ahead > 0:
-                _time.sleep(min(ahead, 0.25))
+            while True:
+                now = _time.monotonic()
+                if self.ramp_step:
+                    rate = self.rate + self.ramp_step * int(
+                        (now - t0) / self.ramp_interval_s)
+                allowed += rate * (now - last)
+                # cap the bucket at a 0.25s burst: a stall (e.g. the
+                # inner source generating its stream) must not bank
+                # budget to be spent as an over-rate burst afterwards
+                allowed = min(allowed, sent + 0.25 * rate)
+                last = now
+                if sent <= allowed:
+                    break
+                _time.sleep(min((sent - allowed) / rate, 0.25))

@@ -45,7 +45,8 @@ class ResultSink:
         self.fmt = fmt
         self.rows_written = 0
         self._lock = threading.Lock()
-        self._fh: io.TextIOBase | None = open(path, "a", encoding="utf-8")
+        self._fh: io.TextIOBase | None = open(path, "a", encoding="utf-8",
+                                              newline="")   # csv contract
         self._csv = csv.writer(self._fh) if fmt == "csv" else None
         self._header_done = fmt != "csv"
 

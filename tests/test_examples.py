@@ -312,9 +312,12 @@ def test_gab_raw_post_parser_unfolds_hetero_graph():
     types = sorted(u.props["!type"] for u in eadds)
     assert types == ["childToParent", "postToTopic", "postToUser",
                      "postToUser", "userToPost", "userToPost"]
+    # child→parent at the CHILD's time (deliberate fix of the reference's
+    # inverted, parent-stamped edge — see the parser docstring)
     c2p = next(u for u in eadds if u.props["!type"] == "childToParent")
-    assert c2p.src == assign_id("gab:post:7")
-    assert c2p.dst == assign_id("gab:post:5")
+    assert c2p.src == assign_id("gab:post:5")
+    assert c2p.dst == assign_id("gab:post:7")
+    assert c2p.time == 1470837486
 
     # drives the pipeline end-to-end and the topic analyser sees the topic
     pipe = IngestionPipeline()
