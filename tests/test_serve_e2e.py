@@ -1,0 +1,119 @@
+"""Serve-role end-to-end: staged ingestion + warm views + file sinks +
+REST + metrics in ONE subprocess (the deployment shape, not unit wiring)."""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("serve")
+    csv = tmp / "g.csv"
+    rng = np.random.default_rng(3)
+    rows = ["src,dst,time"] + [
+        f"{a},{b},{t}" for t, a, b in zip(
+            np.sort(rng.integers(0, 1000, 4000)),
+            rng.integers(0, 60, 4000), rng.integers(0, 60, 4000))]
+    csv.write_text("\n".join(rows) + "\n")
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    rest, metrics = free_port(), free_port()
+    env = {
+        "RAPHTORY_TPU_REST_PORT": str(rest),
+        "RAPHTORY_TPU_METRICS_PORT": str(metrics),
+        "RAPHTORY_TPU_SINK_DIR": str(tmp / "out"),
+        "RAPHTORY_TPU_INGEST_QUEUE_EVENTS": "4096",
+        "RAPHTORY_TPU_ARCHIVING": "0",
+        "RAPHTORY_TPU_COMPRESSING": "0",
+    }
+    import os
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "raphtory_tpu", "serve", "--csv", str(csv),
+         "--skip-header", "--platform", "cpu"],
+        env={**os.environ, **env}, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, cwd="/root/repo")
+    deadline = time.monotonic() + 60
+    up = False
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{rest}/Jobs", timeout=1)
+            up = True
+            break
+        except Exception:
+            if proc.poll() is not None:
+                break
+            time.sleep(0.3)
+    if not up:
+        out = proc.stdout.read() if proc.poll() is not None else "(alive)"
+        proc.kill()
+        pytest.fail(f"serve did not come up: {out[-1500:]}")
+    yield {"rest": rest, "metrics": metrics, "tmp": tmp, "proc": proc}
+    proc.terminate()
+    proc.wait(15)
+
+
+def _post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+
+def _get(port, path):
+    return json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30).read())
+
+
+def test_range_job_with_sink_over_rest(node):
+    out = _post(node["rest"], "/RangeAnalysisRequest", {
+        "analyserName": "PageRank", "start": 200, "end": 900, "jump": 350,
+        "jobID": "e2e_pr", "sinkName": "pr.jsonl",
+        "params": {"max_steps": 10}})
+    assert out["jobID"] == "e2e_pr"
+    assert out["sinkPath"].endswith("out/pr.jsonl")
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        res = _get(node["rest"], "/AnalysisResults?jobID=e2e_pr")
+        if res["status"] in ("done", "failed"):
+            break
+        time.sleep(0.5)
+    assert res["status"] == "done", res["error"]
+    assert [r["time"] for r in res["results"]] == [200, 550, 900]
+    disk = [json.loads(x) for x in
+            (node["tmp"] / "out" / "pr.jsonl").read_text().splitlines()]
+    assert [r["time"] for r in disk] == [200, 550, 900]
+
+
+def test_repeat_views_and_metrics(node):
+    for i, t in enumerate((300, 600, 950)):
+        out = _post(node["rest"], "/ViewAnalysisRequest", {
+            "analyserName": "DegreeBasic", "timestamp": t,
+            "jobID": f"e2e_v{i}"})
+        jid = out["jobID"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            res = _get(node["rest"], f"/AnalysisResults?jobID={jid}")
+            if res["status"] in ("done", "failed"):
+                break
+            time.sleep(0.3)
+        assert res["status"] == "done", res["error"]
+    # Prometheus surface exposes the round's new gauges
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{node['metrics']}/metrics", timeout=10
+    ).read().decode()
+    assert "raphtory_ingest_backlog_events" in text
+    assert "raphtory_views_computed_total" in text
