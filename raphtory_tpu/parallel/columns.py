@@ -99,4 +99,12 @@ def run_columns_sharded(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
         put(v_lat), put(v_alive),
         jnp.asarray(hop_of_col), jnp.asarray(T_col), jnp.asarray(w_col),
         *(put(a) for a in extra_host))
+    if jax.process_count() > 1:
+        # the columns span processes' devices — replicate back to every
+        # host (reducers are host code), like parallel/sharded.py does
+        from jax.experimental import multihost_utils
+
+        result = multihost_utils.process_allgather(result, tiled=True)
+        steps = multihost_utils.process_allgather(steps, tiled=True)
+        return result[:C], int(np.max(steps))
     return result[:C], int(np.max(np.asarray(steps)))

@@ -81,6 +81,21 @@ for T in (50, 75, 100):
                        - float(np.asarray(got_s)[wi, p])) < 1e-5, \
                 (T, wi, int(vid))
 
+# column-sharded range sweep across BOTH processes: the (hop, window)
+# VIEW axis spreads over the 4-device global mesh (round-5 engine)
+from raphtory_tpu.engine.hopbatch import HopBatchedPageRank
+from raphtory_tpu.parallel.columns import run_columns_sharded
+
+hops = [50, 75, 100, 100]
+hb = HopBatchedPageRank(log, tol=0.0, max_steps=10)
+one, _ = hb.run(hops, [100, 20])
+hb2 = HopBatchedPageRank(log, tol=0.0, max_steps=10)
+_, cols = hb2._fold_columns(hops)
+many, _ = run_columns_sharded(hb2.tables, *cols, hops, [100, 20],
+                              jax.devices(), kind="pagerank",
+                              damping=0.85, tol=0.0, max_steps=10)
+np.testing.assert_array_equal(np.asarray(one), np.asarray(many))
+
 print(f"proc {pid} ok steps={int(steps)}", flush=True)
 '''
 
