@@ -224,6 +224,8 @@ class IngestionPipeline:
     def _consume_inner(self, source: Source, parser: Parser) -> None:
         if self._consume_bulk(source, parser):
             return
+        if self._consume_columnar(source, parser):
+            return
         bt, bk, bs, bd = [], [], [], []
         pending_props: list[tuple[int, dict]] = []  # (batch offset, props)
         max_t = -(2**62)
@@ -279,6 +281,29 @@ class IngestionPipeline:
         flush(wm=(max_t - source.disorder - 1)
               if max_t > -(2**62) else None)
         self.counts[source.name] = n
+
+    def _consume_columnar(self, source: Source, parser: Parser) -> bool:
+        """Columnar source protocol: ``iter_batches`` yields ``(t, k, s,
+        d)`` arrays that go straight to the sink — no per-object Python.
+        Only identity parsing qualifies (the arrays ARE the updates)."""
+        batches = getattr(source, "iter_batches", lambda: None)()
+        if batches is None or not isinstance(parser, IdentityParser):
+            return False
+        n = 0
+        max_t = -(2**62)
+        ctr = METRICS.events_ingested.labels(source.name)
+        for t, k, s, d in batches:
+            if self._stop.is_set():
+                break
+            if not len(t):
+                continue
+            n += len(t)
+            max_t = max(max_t, int(np.max(t)))
+            ctr.inc(len(t))
+            self._sink_batch(source.name, t, k, s, d,
+                             wm=max_t - source.disorder - 1)
+        self.counts[source.name] = n
+        return True
 
     def _consume_bulk(self, source: Source, parser: Parser) -> bool:
         """Native fast path: source exposes a byte buffer and the parser a

@@ -17,13 +17,23 @@ from collections.abc import Iterable, Iterator
 class Source:
     """Base: iterate raw tuples. ``disorder`` bounds how far behind the max
     emitted event-time a later tuple may be (0 = time-ordered stream);
-    the pipeline uses it to hold back the source watermark."""
+    the pipeline uses it to hold back the source watermark.
+
+    Sources that can produce COLUMNAR batches (numpy ``(t, k, s, d)``
+    arrays) may implement ``iter_batches`` — the pipeline then skips the
+    per-object Python row path entirely (the reference pays an actor hop
+    per update; the columnar protocol moves whole arrays)."""
 
     name = "source"
     disorder: int = 0
 
     def __iter__(self) -> Iterator:
         raise NotImplementedError
+
+    def iter_batches(self):
+        """Optional columnar protocol: yield ``(t, k, s, d)`` numpy
+        batches. ``None`` (default) = row path only."""
+        return None
 
 
 class IterableSource(Source):
@@ -73,13 +83,40 @@ class RandomSource(Source):
     objects directly (its parser is the identity)."""
 
     def __init__(self, n_events: int, id_pool: int = 1_000_000, seed: int = 0,
-                 mix=(0.3, 0.7, 0.0, 0.0), name: str = "random"):
+                 mix=(0.3, 0.7, 0.0, 0.0), name: str = "random",
+                 columnar: bool = True):
         self.n_events = n_events
         self.id_pool = id_pool
         self.seed = seed
         self.mix = mix
         self.name = name
         self.disorder = 0
+        self.columnar = columnar   # False forces the per-object row path
+
+    def iter_batches(self, batch: int = 8192, chunk: int = 4_000_000):
+        """Columnar batches straight from the generator arrays, produced
+        in ``chunk``-sized segments (bounded memory for long streams —
+        each segment owns a consecutive slice of event time, so the
+        stream stays globally time-sorted)."""
+        if not self.columnar:
+            return None
+        return self._gen_batches(batch, chunk)
+
+    def _gen_batches(self, batch: int, chunk: int):
+        from ..utils.synth import random_update_stream
+
+        done = 0
+        seg = 0
+        while done < self.n_events:
+            n = min(chunk, self.n_events - done)
+            t, k, s, d = random_update_stream(
+                n, self.id_pool, self.seed + seg, mix=self.mix,
+                t_start=done, t_end=done + n)
+            for off in range(0, n, batch):
+                sl = slice(off, off + batch)
+                yield t[sl], k[sl], s[sl], d[sl]
+            done += n
+            seg += 1
 
     def __iter__(self):
         from ..core import events as ev
@@ -114,31 +151,65 @@ class RateLimited(Source):
         self.name = f"ratelimited({inner.name})"
         self.disorder = inner.disorder
 
-    def __iter__(self):
-        # token bucket integrated over the RAMP: budget accrues at the
-        # rate in effect during each elapsed slice. The naive
-        # ``sent/rate(now) vs elapsed`` check would retroactively apply
-        # the ramped-up rate to the whole elapsed time, letting the
-        # source burst ~2x nominal right after every ramp step — which
-        # silently broke the saturation oracle built on offered rates.
-        rate = self.rate
-        t0 = last = _time.monotonic()
-        sent = 0
-        allowed = 0.0
-        for item in self.inner:
-            yield item
-            sent += 1
+    def _pace(self):
+        """Shared ramped token bucket: returns pay(n) which blocks until
+        ``n`` more items fit the integral of the ramp (0.25s burst cap)."""
+        state = {"rate": self.rate, "t0": None, "last": None,
+                 "sent": 0, "allowed": 0.0}
+
+        def pay(n: int):
+            if state["t0"] is None:
+                # the ramp clock starts at the FIRST emission, not at
+                # iterator construction — a slow inner source (stream
+                # generation, connection setup) must not pre-age the ramp
+                state["t0"] = state["last"] = _time.monotonic()
+            state["sent"] += n
             while True:
                 now = _time.monotonic()
                 if self.ramp_step:
-                    rate = self.rate + self.ramp_step * int(
-                        (now - t0) / self.ramp_interval_s)
-                allowed += rate * (now - last)
-                # cap the bucket at a 0.25s burst: a stall (e.g. the
-                # inner source generating its stream) must not bank
-                # budget to be spent as an over-rate burst afterwards
-                allowed = min(allowed, sent + 0.25 * rate)
-                last = now
-                if sent <= allowed:
-                    break
-                _time.sleep(min((sent - allowed) / rate, 0.25))
+                    state["rate"] = self.rate + self.ramp_step * int(
+                        (now - state["t0"]) / self.ramp_interval_s)
+                state["allowed"] += state["rate"] * (now - state["last"])
+                state["allowed"] = min(state["allowed"],
+                                       state["sent"] + 0.25 * state["rate"])
+                state["last"] = now
+                if state["sent"] <= state["allowed"]:
+                    return
+                _time.sleep(min(
+                    (state["sent"] - state["allowed"]) / state["rate"],
+                    0.25))
+
+        return pay
+
+    def iter_batches(self):
+        """Columnar pacing: the inner source's batches re-sliced so one
+        token payment never blocks longer than ~0.5s at the base rate —
+        the consumer thread must stay responsive to ``pipeline.stop()``
+        (which only checks between yields)."""
+        inner = self.inner.iter_batches()
+        if inner is None:
+            return None
+
+        def gen():
+            pay = self._pace()
+            step_n = max(1, int(self.rate * 0.5))
+            for b in inner:
+                n = len(b[0])
+                for off in range(0, n, step_n):
+                    sub = tuple(a[off:off + step_n] for a in b)
+                    yield sub
+                    pay(len(sub[0]))
+
+        return gen()
+
+    def __iter__(self):
+        # token bucket integrated over the RAMP: budget accrues at the
+        # rate in effect during each elapsed slice, capped at a 0.25s
+        # burst. The naive ``sent/rate(now) vs elapsed`` check would
+        # retroactively apply the ramped-up rate to the whole elapsed
+        # time, letting the source burst ~2x nominal right after every
+        # ramp step — which silently broke the saturation oracle.
+        pay = self._pace()
+        for item in self.inner:
+            yield item
+            pay(1)
