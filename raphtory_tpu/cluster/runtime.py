@@ -98,6 +98,34 @@ class NodeRuntime:
         self.pipeline.start()
         if wait:
             self.pipeline.join()
+            if self.settings.prewarm:
+                self.prewarm()
+
+    def prewarm(self, block: bool = False) -> None:
+        """Pin the resident View sweep now (background by default) so the
+        first View/Live query runs the warm path instead of paying the
+        table build + upload + compile."""
+        import threading
+
+        def _pin():
+            t = min(self.graph.safe_time(), self.graph.latest_time)
+            if t < -(2**61):
+                return   # empty graph: nothing to pin
+            acq = self.graph.resident_acquire(int(t))
+            if acq is not None:
+                sweep, lock = acq
+                try:
+                    sweep.advance(int(t))
+                except Exception:
+                    self.graph.resident_discard()
+                finally:
+                    lock.release()
+
+        if block:
+            _pin()
+        else:
+            threading.Thread(target=_pin, name="prewarm",
+                             daemon=True).start()
 
     def submit(self, program, query):
         return self.manager.submit(program, query)

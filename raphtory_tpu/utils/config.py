@@ -78,6 +78,10 @@ class Settings:
     # backlog gauge — the writer-mailbox shape; 0 = direct appends
     ingest_queue_events: int = 0
 
+    # build the resident View sweep right after ingest (background), so
+    # the FIRST REST View is already warm instead of paying the pin
+    prewarm: bool = False
+
     @classmethod
     def from_env(cls, prefix: str = "RAPHTORY_TPU_") -> "Settings":
         kw = {}

@@ -184,3 +184,35 @@ def test_node_runtime_staged_ingestion_setting():
     assert node.pipeline.backlog() == 0
     assert node.graph.log.n == 3000
     node.stop()
+
+
+def test_prewarm_pins_resident_sweep():
+    import numpy as np
+
+    from raphtory_tpu.cluster.runtime import NodeRuntime
+    from raphtory_tpu.ingestion.source import IterableSource
+    from raphtory_tpu.ingestion.updates import EdgeAdd
+    from raphtory_tpu.utils.config import Settings
+
+    node = NodeRuntime(settings=Settings(
+        prewarm=True, archiving=False, compressing=False))
+    ups = [EdgeAdd(t, t % 9, (t + 1) % 9) for t in range(400)]
+    node.add_source(IterableSource(ups, name="s"))
+    node.ingest(wait=True)
+    # the background pin lands shortly after ingest
+    import time as _t
+
+    deadline = _t.monotonic() + 30
+    while node.graph._resident is None and _t.monotonic() < deadline:
+        _t.sleep(0.05)
+    assert node.graph._resident is not None
+    assert node.graph._resident.t_now == 399
+    # and a first View query rides it (same object, advanced not re-pinned)
+    from raphtory_tpu.jobs import registry
+    from raphtory_tpu.jobs.manager import ViewQuery
+
+    pinned = node.graph._resident
+    job = node.submit(registry.resolve("DegreeBasic"), ViewQuery(399))
+    assert job.wait(60) and job.status == "done", job.error
+    assert node.graph._resident is pinned
+    node.stop()
