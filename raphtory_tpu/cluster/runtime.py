@@ -20,6 +20,10 @@ from ..utils.scheduler import Scheduler
 from .bootstrap import bootstrap, topology
 from .watchdog import WatchDog
 
+import logging
+
+_log = logging.getLogger(__name__)
+
 
 class NodeRuntime:
     def __init__(self, settings: Settings | None = None, mesh=None,
@@ -100,26 +104,41 @@ class NodeRuntime:
             self.pipeline.join()
             if self.settings.prewarm:
                 self.prewarm()
+        elif self.settings.prewarm:
+            # serve path: chase the pipeline from a side thread so the
+            # FIRST REST View still lands on a pinned sweep
+            import threading
+
+            threading.Thread(
+                target=lambda: (self.pipeline.join(), self.prewarm(True)),
+                name="prewarm-after-ingest", daemon=True).start()
 
     def prewarm(self, block: bool = False) -> None:
         """Pin the resident View sweep now (background by default) so the
         first View/Live query runs the warm path instead of paying the
-        table build + upload + compile."""
+        table build + upload + compile. Device trouble during the pin is
+        logged and dropped — queries then just take the cold path."""
         import threading
 
         def _pin():
-            t = min(self.graph.safe_time(), self.graph.latest_time)
-            if t < -(2**61):
-                return   # empty graph: nothing to pin
-            acq = self.graph.resident_acquire(int(t))
-            if acq is not None:
-                sweep, lock = acq
-                try:
-                    sweep.advance(int(t))
-                except Exception:
-                    self.graph.resident_discard()
-                finally:
-                    lock.release()
+            try:
+                t = min(self.graph.safe_time(), self.graph.latest_time)
+                if t < -(2**61):
+                    return   # empty graph: nothing to pin
+                acq = self.graph.resident_acquire(int(t))
+                if acq is not None:
+                    sweep, lock = acq
+                    try:
+                        sweep.advance(int(t))
+                    except Exception:
+                        self.graph.resident_discard()
+                    finally:
+                        lock.release()
+            except Exception:
+                # same failure mode jobs/manager.py guards: DeviceSweep
+                # construction can raise on device trouble mid-upload
+                _log.warning("prewarm pin failed; queries will run cold",
+                             exc_info=True)
 
         if block:
             _pin()

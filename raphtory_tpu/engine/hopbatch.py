@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import time as _time
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -60,15 +61,21 @@ def _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
 def _masks_from_deltas(tdt, H: int, W: int,
                        be_lat, be_alive, bv_lat, bv_alive,
                        de_pos, de_lat, de_alive,
-                       dv_pos, dv_lat, dv_alive, T_col, w_col):
+                       dv_pos, dv_lat, dv_alive, T_col, w_col,
+                       h0: bool = False):
     """Device-side fold-column rebuild: hop 0's full state plus per-hop
     touched-entity deltas (scatter-SET in hop order — delete-wins and
     revivals are already resolved by the host fold, so the delta VALUES
     are exact) replace the ``[H, m_pad]`` host-built columns. A sweep
     ships O(base + Σ delta) bytes instead of O(H · m_pad) — the term that
     made the host fold+transfer the binding cost of the headline sweep.
+    ``h0=True`` additionally applies delta[0] BEFORE hop 0's column: the
+    base args are then the previous dispatch's device-resident advanced
+    state and delta[0] is the inter-batch catch-up, so a follow-on batch
+    ships only deltas (the tunnel-link term of a chunked sweep).
     Same windowing test as ``_column_masks``; pad rows carry a huge
-    positive index and are dropped by the scatter."""
+    positive index and are dropped by the scatter. Returns the masks plus
+    the ADVANCED base (state after the last hop) for the next dispatch."""
     info = jnp.iinfo(tdt)
     lo = jnp.clip(T_col - w_col, info.min, info.max).astype(tdt)   # [C]
     nowin = w_col < 0
@@ -76,18 +83,19 @@ def _masks_from_deltas(tdt, H: int, W: int,
     def build(b_lat, b_alive, d_pos, d_lat, d_alive):
         cur_l, cur_a, cols = b_lat, b_alive, []
         for h in range(H):     # H static and small: unrolled 1D scatters
-            if h:
+            if h or h0:
                 cur_l = cur_l.at[d_pos[h]].set(d_lat[h], mode="drop")
                 cur_a = cur_a.at[d_pos[h]].set(d_alive[h], mode="drop")
             sl = slice(h * W, (h + 1) * W)
             cols.append(cur_a[:, None]
                         & (nowin[sl][None, :]
                            | (cur_l[:, None] >= lo[sl][None, :])))
-        return jnp.concatenate(cols, axis=1)   # [len, H*W] hop-major
+        # [len, H*W] hop-major + the post-last-hop state
+        return jnp.concatenate(cols, axis=1), cur_l, cur_a
 
-    me = build(be_lat, be_alive, de_pos, de_lat, de_alive)
-    mv = build(bv_lat, bv_alive, dv_pos, dv_lat, dv_alive)
-    return me, mv
+    me, fe_lat, fe_alive = build(be_lat, be_alive, de_pos, de_lat, de_alive)
+    mv, fv_lat, fv_alive = build(bv_lat, bv_alive, dv_pos, dv_lat, dv_alive)
+    return me, mv, (fe_lat, fe_alive, fv_lat, fv_alive)
 
 
 def _edge_tile_for(m_pad: int, C: int, budget_bytes: int = 1 << 28) -> int | None:
@@ -230,44 +238,70 @@ def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
 def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
                     U_e: int, U_v: int, tdt: str, warm: bool,
                     algo_args: tuple, weighted: bool = False,
-                    U_w: int = 0):
+                    U_w: int = 0, h0: bool = False):
     """Delta-fed columnar kernels: masks rebuilt on device from base state
     + per-hop deltas (``_masks_from_deltas``), then the shared algorithm
     body. ``kind``: pagerank | cc | bfs (``weighted`` adds a per-pair
     weight state rebuilt the same way); ``algo_args`` is the algorithm's
-    static parameter tuple."""
+    static parameter tuple. ``h0=True`` is the resident-base variant: the
+    base inputs are the previous dispatch's advanced state, delta[0] is
+    applied before hop 0. Every variant returns ``(result, steps,
+    advanced_base)`` so the caller can keep the fold state on device."""
     tdt_ = jnp.dtype(tdt)
 
     def run(e_src, e_dst, be_lat, be_alive, bv_lat, bv_alive,
             de_pos, de_lat, de_alive, dv_pos, dv_lat, dv_alive,
             T_col, w_col, *rest):
-        me, mv = _masks_from_deltas(
+        me, mv, adv = _masks_from_deltas(
             tdt_, H, W, be_lat, be_alive, bv_lat, bv_alive,
             de_pos, de_lat, de_alive, dv_pos, dv_lat, dv_alive,
-            T_col, w_col)
+            T_col, w_col, h0=h0)
         if kind == "pagerank":
             damping, tol, max_steps = algo_args
-            return _pagerank_columns(me, mv, e_src, e_dst, n_pad,
-                                     damping, tol, max_steps,
-                                     r_init=rest[0] if warm else None)
+            out, steps = _pagerank_columns(
+                me, mv, e_src, e_dst, n_pad, damping, tol, max_steps,
+                r_init=rest[0] if warm else None)
+            return out, steps, adv
         if kind == "cc":
             (max_steps,) = algo_args
-            return _cc_columns(me, mv, e_src, e_dst, n_pad, max_steps)
+            out, steps = _cc_columns(me, mv, e_src, e_dst, n_pad, max_steps)
+            return out, steps, adv
         max_steps, directed = algo_args
         ew = 1.0
         if weighted:
             _, w_base, dw_pos, dw_val = rest
             cur_w, cols = w_base, []
             for h in range(H):   # same unrolled rebuild as the masks
-                if h:
+                if h or h0:
                     cur_w = cur_w.at[dw_pos[h]].set(dw_val[h], mode="drop")
                 cols.append(jnp.broadcast_to(
                     cur_w[:, None], (cur_w.shape[0], W)))
             ew = jnp.concatenate(cols, axis=1)   # [m_pad, C] hop-major
-        return _bfs_columns(me, mv, e_src, e_dst, n_pad, max_steps,
-                            directed, rest[0], ew)   # rest[0]: seed mask
+            adv = adv + (cur_w,)
+        out, steps = _bfs_columns(me, mv, e_src, e_dst, n_pad, max_steps,
+                                  directed, rest[0], ew)  # rest[0]: seeds
+        return out, steps, adv
 
     return jax.jit(run)
+
+
+#: per-log cache of the device-uploaded static (src, dst) engine tables —
+#: a cold engine over an unchanged log reuses the resident arrays instead
+#: of re-shipping 2 * m_pad int32 over the host↔device link per query
+_DEVICE_EDGES = weakref.WeakKeyDictionary()
+
+
+def _device_edges(log, tables):
+    """Device (e_src, e_dst) for ``tables``, cached per log. The (m, n)
+    key is exact: pairs and vertices are never removed from a log, so
+    equal counts mean the identical deterministic table (same pair set,
+    same dense ranks, same (dst, src) sort)."""
+    ent = _DEVICE_EDGES.get(log)
+    if ent is not None and ent[0] == tables.m and ent[1] == tables.n:
+        return ent[2], ent[3]
+    es, ed = jnp.asarray(tables.e_src), jnp.asarray(tables.e_dst)
+    _DEVICE_EDGES[log] = (tables.m, tables.n, es, ed)
+    return es, ed
 
 
 def _pad_hop_deltas(deltas, H: int, tdt):
@@ -289,11 +323,16 @@ def _pad_hop_deltas(deltas, H: int, tdt):
 def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
                       windows, *, algo_args: tuple, seed_mask=None,
                       e_src_dev=None, e_dst_dev=None, r_init=None,
-                      weight_base=None, weight_deltas=None):
+                      weight_base=None, weight_deltas=None,
+                      h0_delta: bool = False):
     """Dispatch a delta-fed columnar kernel (``kind``: pagerank|cc|bfs)
-    over ``_HopBatched._fold_deltas`` output. ``weight_base`` +
-    ``weight_deltas`` ([(pos, val)] per hop) turn bfs into weighted SSSP
-    with the weight state rebuilt on device too."""
+    over ``_HopBatched._fold_deltas`` output; returns ``(result, steps,
+    advanced_base)``. ``weight_base`` + ``weight_deltas`` ([(pos, val)]
+    per hop) turn bfs into weighted SSSP with the weight state rebuilt on
+    device too. ``h0_delta=True`` means ``base`` (and ``weight_base``)
+    are the previous dispatch's device-resident advanced state and
+    delta[0] carries the inter-batch catch-up — the sweep then ships
+    O(Σ delta) bytes with no full-table upload at all."""
     H, C, _, T_col, w_col = _column_layout(hop_times, windows)
     W = C // H
     be_lat, be_alive, bv_lat, bv_alive = base
@@ -313,7 +352,7 @@ def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
     runner = _compiled_delta(kind, tables.n_pad, tables.m_pad, H, W,
                              U_e, U_v, np.dtype(tdt).name,
                              r_init is not None, tuple(algo_args),
-                             weighted, U_w)
+                             weighted, U_w, h0_delta)
     extra = []
     if seed_mask is not None:
         extra.append(seed_mask)
@@ -541,6 +580,9 @@ class _HopBatched:
         # the per-hop add-row list merges are skipped entirely
         self.sw = SweepBuilder(log, track_rows=False, preseed_pairs=True)
         self.tables = GlobalTables(self.sw)
+        # cache key for the device edge tables: the CALLER's log object
+        # (sw.log is a fresh pin per engine and would never hit)
+        self._log = log
         #: host seconds spent folding + writing columns in the LAST run()
         #: (callers report it as snapshot-build time)
         self.fold_seconds = 0.0
@@ -550,20 +592,44 @@ class _HopBatched:
         self._edges = None
         # running host base for the delta-fold path (built on first use)
         self._delta_base = None
+        # device-resident advanced base: the last delta dispatch's
+        # post-final-hop fold state, fed back as the next dispatch's base
+        # so follow-on chunks/batches ship only deltas (the host↔device
+        # link, not the fold, is the binding cost on a tunnelled device)
+        self._dev_base = None
 
     @property
     def _e_src(self):
         if self._edges is None:
-            self._edges = (jnp.asarray(self.tables.e_src),
-                           jnp.asarray(self.tables.e_dst))
+            self._edges = _device_edges(self._log, self.tables)
         return self._edges[0]
 
     @property
     def _e_dst(self):
         if self._edges is None:
-            self._edges = (jnp.asarray(self.tables.e_src),
-                           jnp.asarray(self.tables.e_dst))
+            self._edges = _device_edges(self._log, self.tables)
         return self._edges[1]
+
+    def _delta_base_args(self, ship_base):
+        """(base_for_dispatch, h0_delta): the device-resident advanced
+        state when the fold shipped no base snapshot, else the host
+        snapshot (first batch, or residency was invalidated)."""
+        if ship_base is None:
+            return tuple(self._dev_base[:4]), True
+        return ship_base, False
+
+    def _run_delta(self, fn):
+        """Run a delta dispatch and keep its advanced base device-resident;
+        any dispatch-time failure drops residency so the next batch falls
+        back to shipping a fresh base snapshot (execute-time failures are
+        the jobs layer's concern — it rebuilds the engine)."""
+        try:
+            out, steps, adv = fn()
+        except Exception:
+            self._dev_base = None
+            raise
+        self._dev_base = adv
+        return out, steps
 
     #: set True by subclasses whose iteration is a contraction (safe to
     #: warm-start from the previous chunk's solution)
@@ -618,6 +684,19 @@ class _HopBatched:
                 "just slower)")
         hop_times = [int(x) for x in hop_times]
         chunks = max(1, min(int(chunks), len(hop_times)))
+        try:
+            return self._run_chunks(hop_times, windows, chunks, warm_start,
+                                    hop_callback)
+        except Exception:
+            # ANY mid-run failure (fold, hop_callback, dispatch) may leave
+            # the host fold ahead of the device-resident base — drop
+            # residency so the next batch ships a fresh snapshot instead
+            # of silently scattering onto a stale device state
+            self._dev_base = None
+            raise
+
+    def _run_chunks(self, hop_times, windows, chunks, warm_start,
+                    hop_callback):
         if chunks == 1 or len(hop_times) % chunks:
             # unequal groups would compile one program per distinct size —
             # pipeline only when the split is clean
@@ -666,6 +745,7 @@ class _HopBatched:
         # running delta base — a later delta-fold call must rebuild it or
         # it would scatter one hop's delta onto a stale base
         self._delta_base = None
+        self._dev_base = None
         t = self.tables
         hop_times = [int(x) for x in hop_times]
         if sorted(hop_times) != hop_times:
@@ -760,6 +840,10 @@ class _HopBatched:
         tdt = t.tdtype
         deltas_e, deltas_v = [], []
         ship_base = None
+        # a live device-resident base makes this batch all-delta: hop 0's
+        # catch-up ships in the delta[0] slot instead of a base snapshot
+        resident = (self._dev_base is not None
+                    and self._delta_base is not None)
         empty = (np.empty(0, np.int32), np.empty(0, tdt),
                  np.empty(0, bool))
         for j, T in enumerate(hop_times):
@@ -781,10 +865,10 @@ class _HopBatched:
                 self._delta_base = [be_lat, be_alive, bv_lat, bv_alive]
             else:
                 de, dv = self._apply_delta_to_base()
-                if j > 0:
+                if j > 0 or resident:
                     deltas_e.append(de)
                     deltas_v.append(dv)
-            if j == 0:
+            if j == 0 and not resident:
                 # snapshot the running base as this batch's upload (the
                 # arrays keep mutating through later hops; jnp.asarray is
                 # async, so the copy must be taken now)
@@ -818,11 +902,15 @@ class HopBatchedPageRank(_HopBatched):
             e_src_dev=self._e_src, e_dst_dev=self._e_dst, r_init=r_init)
 
     def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
-        return run_columns_delta(
-            "pagerank", self.tables, *payload, hop_times, windows,
+        base, deltas_e, deltas_v = payload
+        base, h0 = self._delta_base_args(base)
+        return self._run_delta(lambda: run_columns_delta(
+            "pagerank", self.tables, base, deltas_e, deltas_v,
+            hop_times, windows,
             algo_args=(float(self.damping), float(self.tol),
                        int(self.max_steps)),
-            e_src_dev=self._e_src, e_dst_dev=self._e_dst, r_init=r_init)
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst, r_init=r_init,
+            h0_delta=h0))
 
 
 class HopBatchedBFS(_HopBatched):
@@ -847,11 +935,14 @@ class HopBatchedBFS(_HopBatched):
 
     def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
         assert r_init is None   # guarded by supports_warm_start
-        return run_columns_delta(
-            "bfs", self.tables, *payload, hop_times, windows,
+        base, deltas_e, deltas_v = payload
+        base, h0 = self._delta_base_args(base)
+        return self._run_delta(lambda: run_columns_delta(
+            "bfs", self.tables, base, deltas_e, deltas_v,
+            hop_times, windows,
             algo_args=(int(self.max_steps), bool(self.directed)),
             seed_mask=_seed_mask(self.tables, self.seeds),
-            e_src_dev=self._e_src, e_dst_dev=self._e_dst)
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst, h0_delta=h0))
 
 
 class HopBatchedSSSP(HopBatchedBFS):
@@ -939,16 +1030,18 @@ class HopBatchedSSSP(HopBatchedBFS):
         hop_times, cols = super()._fold_columns(hop_times, hop_callback)
         return hop_times, (*cols, self._weight_cols(hop_times))
 
-    def _weight_deltas(self, hop_times):
+    def _weight_deltas(self, hop_times, resident: bool = False):
         """Per-hop (pos, val) weight updates + the running state at hop 0
-        of this batch — the delta twin of ``_weight_cols``."""
+        of this batch — the delta twin of ``_weight_cols``. ``resident``
+        mirrors the mask fold's decision: hop 0's catch-up ships as
+        delta[0] against the device-held weight state, w_base is None."""
         wd = []
         w_base = None
         for j, T in enumerate(hop_times):
             hi = int(np.searchsorted(self._w_t, T, side="right"))
             pos = self._w_pos[self._w_cursor:hi].astype(np.int32)
             val = self._w_val[self._w_cursor:hi]
-            if j > 0 and len(pos):
+            if (j > 0 or resident) and len(pos):
                 # last-wins per pair WITHIN the hop: XLA scatter order is
                 # undefined for duplicate indices, so the dedup must happen
                 # here (the host fold's sequential assignment is last-wins
@@ -961,7 +1054,8 @@ class HopBatchedSSSP(HopBatchedBFS):
                 self._w_state[self._w_pos[self._w_cursor:hi]] = \
                     self._w_val[self._w_cursor:hi]
                 self._w_cursor = hi
-            if j == 0:   # updates at/before hop 0 belong to the base
+            if j == 0 and not resident:
+                # updates at/before hop 0 belong to the base
                 w_base = self._w_state.copy()
                 wd.append((pos[:0], val[:0]))
             else:
@@ -970,7 +1064,11 @@ class HopBatchedSSSP(HopBatchedBFS):
 
     def _fold_deltas(self, hop_times, hop_callback=None):
         hop_times, payload = super()._fold_deltas(hop_times, hop_callback)
-        return hop_times, (*payload, *self._weight_deltas(hop_times))
+        # payload[0] is None exactly when the mask fold went all-delta
+        # against the device-resident base — the weight fold must match
+        return hop_times, (*payload,
+                           *self._weight_deltas(hop_times,
+                                                resident=payload[0] is None))
 
     def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
         assert r_init is None   # guarded by supports_warm_start
@@ -984,12 +1082,15 @@ class HopBatchedSSSP(HopBatchedBFS):
     def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
         assert r_init is None   # guarded by supports_warm_start
         base, deltas_e, deltas_v, w_base, w_deltas = payload
-        return run_columns_delta(
+        base, h0 = self._delta_base_args(base)
+        if h0:
+            w_base = self._dev_base[4]   # device-resident weight state
+        return self._run_delta(lambda: run_columns_delta(
             "bfs", self.tables, base, deltas_e, deltas_v, hop_times,
             windows, algo_args=(int(self.max_steps), bool(self.directed)),
             seed_mask=_seed_mask(self.tables, self.seeds),
             e_src_dev=self._e_src, e_dst_dev=self._e_dst,
-            weight_base=w_base, weight_deltas=w_deltas)
+            weight_base=w_base, weight_deltas=w_deltas, h0_delta=h0))
 
 
 class HopBatchedCC(_HopBatched):
@@ -1004,10 +1105,12 @@ class HopBatchedCC(_HopBatched):
 
     def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
         assert r_init is None   # guarded by supports_warm_start
-        return run_columns_delta(
-            "cc", self.tables, *payload, hop_times, windows,
-            algo_args=(int(self.max_steps),),
-            e_src_dev=self._e_src, e_dst_dev=self._e_dst)
+        base, deltas_e, deltas_v = payload
+        base, h0 = self._delta_base_args(base)
+        return self._run_delta(lambda: run_columns_delta(
+            "cc", self.tables, base, deltas_e, deltas_v,
+            hop_times, windows, algo_args=(int(self.max_steps),),
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst, h0_delta=h0))
 
     def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
         assert r_init is None   # guarded by supports_warm_start

@@ -421,3 +421,111 @@ def test_edge_tiled_pagerank_matches_single_shot(monkeypatch):
         for c in (hb_mod._compiled, hb_mod._compiled_delta,
                   hb_mod._compiled_cc, hb_mod._compiled_bfs):
             c.cache_clear()
+
+
+def test_delta_fold_resident_across_batches(monkeypatch):
+    """A second delta run() on a live engine ships NO base snapshot (the
+    device-resident advanced state is the base; hop 0's catch-up rides the
+    delta[0] slot) and still matches a fresh engine bitwise — CC and
+    weighted SSSP, deletes/revivals/weight updates included."""
+    import numpy as np
+
+    from raphtory_tpu.engine.hopbatch import HopBatchedCC, HopBatchedSSSP
+
+    monkeypatch.setenv("RTPU_FOLD", "delta")
+    for cls, kw in ((HopBatchedCC, dict(max_steps=30)),
+                    (HopBatchedSSSP, dict(seeds=(1, 2), max_steps=30,
+                                          weight_prop="w"))):
+        log = random_log(np.random.default_rng(21), n_events=900, n_ids=40,
+                         t_span=1000, props=True)
+        hb = cls(log, **kw)
+        hb.run([200, 350], [250, None])
+        assert hb._dev_base is not None
+        # prove the second batch goes all-delta: a shipped base would be a
+        # non-None payload[0]
+        _, payload = hb._fold_deltas([500, 700])
+        assert payload[0] is None
+        got, _ = hb._dispatch_deltas(payload, [500, 700], [250, None])
+        fresh, _ = cls(log, **kw).run([500, 700], [250, None])
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(fresh))
+
+
+def test_delta_fold_residency_drops_on_dispatch_failure(monkeypatch):
+    """A dispatch-time error invalidates the device-resident base, so the
+    next batch falls back to shipping a fresh snapshot (no silent
+    mis-sync between the host fold and a stale device state)."""
+    import numpy as np
+    import pytest
+
+    from raphtory_tpu.engine import hopbatch
+    from raphtory_tpu.engine.hopbatch import HopBatchedCC
+
+    monkeypatch.setenv("RTPU_FOLD", "delta")
+    log = random_log(np.random.default_rng(22), n_events=600, n_ids=30,
+                     t_span=1000)
+    hb = HopBatchedCC(log, max_steps=30)
+    hb.run([200, 350], [None])
+    assert hb._dev_base is not None
+
+    def boom(*a, **k):
+        raise RuntimeError("injected dispatch failure")
+
+    monkeypatch.setattr(hopbatch, "run_columns_delta", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        hb.run([500], [None])
+    assert hb._dev_base is None
+    monkeypatch.undo()
+    monkeypatch.setenv("RTPU_FOLD", "delta")
+    got, _ = hb.run([700, 900], [None])
+    fresh, _ = HopBatchedCC(log, max_steps=30).run([700, 900], [None])
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(fresh))
+
+
+def test_device_edge_tables_cached_per_log():
+    """Cold engines over the same unchanged log share ONE device upload
+    of the static (src, dst) tables (the per-query transfer the tunnel
+    link cannot afford); the cache invalidates when the log grows."""
+    import numpy as np
+
+    from raphtory_tpu.engine.hopbatch import HopBatchedPageRank
+
+    log = random_log(np.random.default_rng(23), n_events=400, n_ids=30,
+                     t_span=500)
+    a = HopBatchedPageRank(log, max_steps=4)
+    b = HopBatchedPageRank(log, max_steps=4)
+    assert a._e_src is b._e_src and a._e_dst is b._e_dst
+
+    log.add_edge(600, 1_000_001, 1_000_002)   # new pair -> new tables
+    c = HopBatchedPageRank(log, max_steps=4)
+    assert c._e_src is not a._e_src
+    np.testing.assert_array_equal(np.asarray(c.tables.e_src)[: c.tables.m],
+                                  np.asarray(c._e_src)[: c.tables.m])
+
+
+def test_delta_fold_residency_drops_on_fold_failure(monkeypatch):
+    """An exception INSIDE the fold (e.g. a hop_callback raising after
+    the host base absorbed part of the batch) also drops residency — the
+    device base is missing the aborted batch's events, so the next run
+    must ship a fresh snapshot, not scatter deltas onto stale state."""
+    import numpy as np
+    import pytest
+
+    from raphtory_tpu.engine.hopbatch import HopBatchedCC
+
+    monkeypatch.setenv("RTPU_FOLD", "delta")
+    log = random_log(np.random.default_rng(24), n_events=600, n_ids=30,
+                     t_span=1000)
+    hb = HopBatchedCC(log, max_steps=30)
+    hb.run([200, 350], [None])
+    assert hb._dev_base is not None
+
+    def cb(T, sw):
+        if T >= 500:
+            raise RuntimeError("injected fold failure")
+
+    with pytest.raises(RuntimeError, match="injected"):
+        hb.run([500, 650], [None], hop_callback=cb)
+    assert hb._dev_base is None
+    got, _ = hb.run([700, 900], [None])
+    fresh, _ = HopBatchedCC(log, max_steps=30).run([700, 900], [None])
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(fresh))
