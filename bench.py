@@ -777,11 +777,15 @@ def bench_scale_pagerank():
     s0 = _time.perf_counter()
     # device-put the big inputs ONCE (jnp.asarray of a device array is a
     # no-op inside run_scale_columns): the timed sweep measures the device
-    # program, not host->device copies
-    base_e = jax.device_put(jnp.asarray(base_e))
-    base_v = jax.device_put(jnp.asarray(base_v))
-    statics = {"e_src_dev": jnp.asarray(bulk.e_src),
-               "e_dst_dev": jnp.asarray(bulk.e_dst)}
+    # program, not host->device copies. Chunked+retried puts: a monolithic
+    # multi-hundred-MB transfer through the tunnel is all-or-nothing and
+    # has died 20 minutes in (UNAVAILABLE mid-put, round-5 log)
+    from raphtory_tpu.utils.transfer import device_put_chunked
+
+    base_e = device_put_chunked(base_e)
+    base_v = device_put_chunked(base_v)
+    statics = {"e_src_dev": device_put_chunked(bulk.e_src),
+               "e_dst_dev": device_put_chunked(bulk.e_dst)}
     kw = dict(tol=0.0, max_steps=iters, **statics)
     warm, _ = run_scale_columns(bulk, base_e, base_v, d_e, d_v, hops,
                                 windows, **kw)

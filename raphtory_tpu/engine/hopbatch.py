@@ -37,7 +37,7 @@ import logging
 
 from ..core.events import EventLog
 from ..core.sweep import SweepBuilder
-from .device_sweep import GlobalTables, normalize_windows
+from .device_sweep import GlobalTables, _device_edges, normalize_windows
 
 _log = logging.getLogger(__name__)
 
@@ -227,9 +227,12 @@ def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
             hop_of_col, T_col, w_col, *rest):
         me, mv = _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
                                hop_of_col, T_col, w_col)
+        # warm arg: previous chunk's full [C, n_pad] output; tail slice +
+        # per-hop tile in-program (see _compiled_delta)
+        W = C // H
+        r0 = jnp.tile(rest[0][-W:], (H, 1)).T if warm else None
         return _pagerank_columns(me, mv, e_src, e_dst, n_pad,
-                                 damping, tol, max_steps,
-                                 r_init=rest[0] if warm else None)
+                                 damping, tol, max_steps, r_init=r0)
 
     return jax.jit(run)
 
@@ -258,9 +261,13 @@ def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
             T_col, w_col, h0=h0)
         if kind == "pagerank":
             damping, tol, max_steps = algo_args
+            # warm arg is the previous chunk's FULL output [C, n_pad]; the
+            # tail slice + per-hop tile happen in-program (host-side array
+            # ops would be extra tunnel round-trips between dispatches)
+            r0 = jnp.tile(rest[0][-W:], (H, 1)).T if warm else None
             out, steps = _pagerank_columns(
                 me, mv, e_src, e_dst, n_pad, damping, tol, max_steps,
-                r_init=rest[0] if warm else None)
+                r_init=r0)
             return out, steps, adv
         if kind == "cc":
             (max_steps,) = algo_args
@@ -283,25 +290,6 @@ def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
         return out, steps, adv
 
     return jax.jit(run)
-
-
-#: per-log cache of the device-uploaded static (src, dst) engine tables —
-#: a cold engine over an unchanged log reuses the resident arrays instead
-#: of re-shipping 2 * m_pad int32 over the host↔device link per query
-_DEVICE_EDGES = weakref.WeakKeyDictionary()
-
-
-def _device_edges(log, tables):
-    """Device (e_src, e_dst) for ``tables``, cached per log. The (m, n)
-    key is exact: pairs and vertices are never removed from a log, so
-    equal counts mean the identical deterministic table (same pair set,
-    same dense ranks, same (dst, src) sort)."""
-    ent = _DEVICE_EDGES.get(log)
-    if ent is not None and ent[0] == tables.m and ent[1] == tables.n:
-        return ent[2], ent[3]
-    es, ed = jnp.asarray(tables.e_src), jnp.asarray(tables.e_dst)
-    _DEVICE_EDGES[log] = (tables.m, tables.n, es, ed)
-    return es, ed
 
 
 def _pad_hop_deltas(deltas, H: int, tdt):
@@ -691,8 +679,14 @@ class _HopBatched:
             # ANY mid-run failure (fold, hop_callback, dispatch) may leave
             # the host fold ahead of the device-resident base — drop
             # residency so the next batch ships a fresh snapshot instead
-            # of silently scattering onto a stale device state
+            # of silently scattering onto a stale device state. The HOST
+            # base must go too: an advance that aborted after consuming
+            # events but before _apply_delta_to_base leaves it missing
+            # that window (last_delta only spans the latest advance), so
+            # the next batch must re-materialise from the sweep's full
+            # state, not snapshot the stale running base.
             self._dev_base = None
+            self._delta_base = None
             raise
 
     def _run_chunks(self, hop_times, windows, chunks, warm_start,
@@ -712,7 +706,6 @@ class _HopBatched:
             hop_times, cols = self._fold_columns(hop_times, hop_callback)
             return self._dispatch_cols(cols, hop_times, windows)
         per = len(hop_times) // chunks
-        W = len(normalize_windows(windows))
         delta = self._use_delta_fold()
         outs = []
         steps = jnp.int32(0)
@@ -724,11 +717,11 @@ class _HopBatched:
                 group, cols = self._fold_columns(group, hop_callback)
             r_init = None
             if warm_start and outs:
-                # previous chunk's last hop: rows [-W:] are its W windowed
-                # columns (hop-major); tile per hop of this group. Lazy
-                # device values — the host pipeline stays async
-                tail = outs[-1][-W:]                       # [W, n_pad]
-                r_init = jnp.tile(tail, (per, 1)).T        # [n_pad, per*W]
+                # previous chunk's FULL output; the kernel slices its last
+                # hop's W windowed rows and tiles them per hop of this
+                # group IN-PROGRAM — no extra host-issued device ops
+                # between dispatches (each is a tunnel round-trip)
+                r_init = outs[-1]                          # [per*W, n_pad]
             if delta:
                 out, st = self._dispatch_deltas(payload, group, windows,
                                                 r_init=r_init)  # async
@@ -1224,8 +1217,9 @@ def run_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times, windows,
     shared by the incremental-fold class above and the add-only bulk loader
     (``core/bulk.bulk_hop_columns``). `tables` needs the GlobalTables /
     BulkGraph surface (n_pad, m_pad, e_src, e_dst, tdtype). ``r_init``
-    ([n_pad, C], device) warm-starts the power iteration — see
-    ``_pagerank_columns``."""
+    (the previous chunk's full ``[C, n_pad]`` hop-major output, device)
+    warm-starts the power iteration: the kernel slices its last hop's W
+    rows and tiles them per hop IN-PROGRAM — see ``_compiled``."""
     H, C, hop_of_col, T_col, w_col = _column_layout(hop_times, windows)
     runner = _compiled(tables.n_pad, tables.m_pad, H, C, float(damping),
                        float(tol), int(max_steps),

@@ -502,18 +502,22 @@ def test_device_edge_tables_cached_per_log():
                                   np.asarray(c._e_src)[: c.tables.m])
 
 
-def test_delta_fold_residency_drops_on_fold_failure(monkeypatch):
+@pytest.mark.parametrize("seed", [2, 8, 10, 24])
+def test_delta_fold_residency_drops_on_fold_failure(monkeypatch, seed):
     """An exception INSIDE the fold (e.g. a hop_callback raising after
-    the host base absorbed part of the batch) also drops residency — the
-    device base is missing the aborted batch's events, so the next run
-    must ship a fresh snapshot, not scatter deltas onto stale state."""
+    the host base absorbed part of the batch) drops BOTH the device
+    residency and the running host base: the aborted advance consumed
+    events that neither captured (last_delta spans only the latest
+    advance), so the next run must re-materialise from the sweep's full
+    state. Seeds 2/8/10 reproduced the stale-host-base corruption when
+    only the device side was cleared."""
     import numpy as np
     import pytest
 
     from raphtory_tpu.engine.hopbatch import HopBatchedCC
 
     monkeypatch.setenv("RTPU_FOLD", "delta")
-    log = random_log(np.random.default_rng(24), n_events=600, n_ids=30,
+    log = random_log(np.random.default_rng(seed), n_events=600, n_ids=30,
                      t_span=1000)
     hb = HopBatchedCC(log, max_steps=30)
     hb.run([200, 350], [None])
