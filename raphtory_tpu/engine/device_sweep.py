@@ -82,7 +82,13 @@ def _device_edges(log, tables):
     ent = _DEVICE_EDGES.get(log)
     if ent is not None and ent[0] == tables.m and ent[1] == tables.n:
         return ent[2], ent[3]
-    es, ed = jnp.asarray(tables.e_src), jnp.asarray(tables.e_dst)
+    from ..utils.transfer import device_put_chunked
+
+    # chunked + retried: at 10^8-pair scale these are the largest single
+    # transfers in the system, and a monolithic put through the tunnel is
+    # all-or-nothing (it has died mid-put and wedged the link)
+    es = device_put_chunked(tables.e_src)
+    ed = device_put_chunked(tables.e_dst)
     _DEVICE_EDGES[log] = (tables.m, tables.n, es, ed)
     return es, ed
 

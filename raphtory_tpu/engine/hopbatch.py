@@ -1127,7 +1127,8 @@ def _dispatch_columns(runner, tables, cols, hop_of_col, T_col,
 
 @functools.lru_cache(maxsize=16)
 def _compiled_scale(n_pad: int, m_pad: int, H: int, W: int, U_e: int,
-                    U_v: int, damping: float, tol: float, max_steps: int):
+                    U_v: int, damping: float, tol: float, max_steps: int,
+                    scan_masks: bool = False):
     """Scale variant of the columnar PageRank: per-hop fold state is
     REBUILT ON DEVICE from the base state plus per-hop update lists, so a
     sweep ships O(base + deltas) bytes instead of O(m_pad * H) — at
@@ -1137,11 +1138,27 @@ def _compiled_scale(n_pad: int, m_pad: int, H: int, W: int, U_e: int,
     so every mask is ONE threshold compare ``lat >= thr`` with
     ``thr = max(T - w, 0)`` (windowed) or 0 (unwindowed), and hop state is
     a running scatter-max of update times. Update lists are (pos, t) pairs
-    padded with (0, INT32_MIN) — a max no-op."""
-    TMIN = jnp.iinfo(jnp.int32).min
+    padded with (0, INT32_MIN) — a max no-op.
+
+    ``scan_masks=True`` builds the hop rebuild as a ``lax.scan`` over hops
+    instead of an H-way unrolled block — an HLO ~H times smaller, kept as
+    the fallback shape for remote compilers that choke on the unrolled
+    program (RTPU_SCALE_MASKS=scan); results are identical (tested)."""
 
     def run(e_src, e_dst, base_e, base_v, de_pos, de_t, dv_pos, dv_t, thr):
+        thr_hw = thr.reshape(H, W)
+
         def hop_masks(base, d_pos, d_t):
+            if scan_masks:
+                def step(cur, inp):
+                    pos, tt, th = inp
+                    cur = cur.at[pos].max(tt)
+                    return cur, cur[:, None] >= th[None, :]   # [len, W]
+
+                _, cols = jax.lax.scan(step, base, (d_pos, d_t, thr_hw))
+                # [H, len, W] -> [len, H*W] hop-major
+                return jnp.swapaxes(cols, 0, 1).reshape(
+                    base.shape[0], H * W)
             cur, cols = base, []
             for h in range(H):     # H static and small: unrolled
                 cur = cur.at[d_pos[h]].max(d_t[h])
@@ -1188,8 +1205,12 @@ def run_scale_columns(bulk, base_e, base_v, deltas_e, deltas_v, hop_times,
     U_e, U_v = pad_for(deltas_e), pad_for(deltas_v)
     de_pos, de_t = pad_deltas(deltas_e, U_e)
     dv_pos, dv_t = pad_deltas(deltas_v, U_v)
+    import os
+
+    scan_masks = os.environ.get("RTPU_SCALE_MASKS", "unroll") == "scan"
     runner = _compiled_scale(bulk.n_pad, bulk.m_pad, H, W, U_e, U_v,
-                             float(damping), float(tol), int(max_steps))
+                             float(damping), float(tol), int(max_steps),
+                             scan_masks)
     return runner(
         e_src_dev if e_src_dev is not None else jnp.asarray(bulk.e_src),
         e_dst_dev if e_dst_dev is not None else jnp.asarray(bulk.e_dst),

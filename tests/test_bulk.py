@@ -127,3 +127,33 @@ def test_bulk_deltas_match_columns_scale_engine():
                                windows, tol=0.0, max_steps=12)
     np.testing.assert_allclose(np.asarray(want), np.asarray(got),
                                atol=1e-6, rtol=0)
+
+
+def test_scale_scan_masks_matches_unrolled(monkeypatch):
+    """RTPU_SCALE_MASKS=scan (the small-HLO fallback for remote compilers
+    that choke on the H-way unrolled rebuild) is bit-identical to the
+    unrolled default."""
+    import numpy as np
+
+    from raphtory_tpu.core.bulk import bulk_hop_deltas
+    from raphtory_tpu.engine.hopbatch import run_scale_columns
+
+    rng = np.random.default_rng(7)
+    n = 30_000
+    src = rng.integers(0, 500, n).astype(np.int64)
+    dst = rng.integers(0, 500, n).astype(np.int64)
+    times = np.sort(rng.integers(0, 100_000, n)).astype(np.int64)
+    hops = [60_000 + 5_000 * k for k in range(5)]
+    windows = [100_000, 20_000, None]
+
+    bulk, base_e, base_v, d_e, d_v = bulk_hop_deltas(
+        src, dst, times, hops, n_vertices=500)
+    kw = dict(tol=0.0, max_steps=8)
+    monkeypatch.delenv("RTPU_SCALE_MASKS", raising=False)
+    a, sa = run_scale_columns(bulk, base_e, base_v, d_e, d_v, hops,
+                              windows, **kw)
+    monkeypatch.setenv("RTPU_SCALE_MASKS", "scan")
+    b, sb = run_scale_columns(bulk, base_e, base_v, d_e, d_v, hops,
+                              windows, **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(sa) == int(sb)

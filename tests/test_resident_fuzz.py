@@ -2,6 +2,7 @@
 appends and View queries must always match a cold rebuild."""
 
 import numpy as np
+import pytest
 
 from raphtory_tpu.core.service import TemporalGraph
 from raphtory_tpu.core.snapshot import build_view
@@ -63,3 +64,51 @@ def test_resident_acquire_never_serves_stale_folds():
             assert got_alive == ref_alive, (step, t_q)
     # the fuzz must actually exercise the warm path
     assert served["resident"] >= 20, served
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_hopbatch_resident_fuzz(monkeypatch, seed):
+    """Fuzz the device-resident delta base across random multi-batch
+    sweeps: an engine reused over K forward batches (random split points,
+    random windows, occasional injected mid-fold failure) must match a
+    fresh engine per batch bitwise — CC (labels) and BFS (distances)."""
+    import numpy as np
+
+    from raphtory_tpu.engine.hopbatch import HopBatchedBFS, HopBatchedCC
+    from test_sweep import random_log
+
+    monkeypatch.setenv("RTPU_FOLD", "delta")
+    rng = np.random.default_rng(1000 + seed)
+    log = random_log(rng, n_events=800, n_ids=35, t_span=2000, props=True)
+
+    cuts = np.sort(rng.choice(np.arange(100, 2000, 50),
+                              size=rng.integers(4, 9), replace=False))
+    k = rng.integers(2, 4)
+    batches = [list(c) for c in np.array_split(cuts, k) if len(c)]
+    windows = [int(rng.integers(100, 2000)), None]
+
+    resident = [HopBatchedCC(log, max_steps=60),
+                HopBatchedBFS(log, (0, 1), max_steps=60)]
+    fail_at = rng.integers(0, len(batches)) if rng.random() < 0.5 else -1
+    for bi, hops in enumerate(batches):
+        if bi == fail_at:
+            def cb(T, sw, _h=hops[-1]):
+                if T >= _h:
+                    raise RuntimeError("injected")
+            for hb in resident:
+                with pytest.raises(RuntimeError, match="injected"):
+                    hb.run([h + 1 for h in hops], windows, hop_callback=cb)
+            # the aborted advance ran through every hop of the batch, so
+            # the fold clock sits at hops[-1]+1 — recovery must continue
+            # strictly forward (later cuts are >= 50 apart, so the next
+            # batch is still ahead)
+            batches[bi] = [hops[-1] + 3]
+            hops = batches[bi]
+        got = [np.asarray(hb.run(hops, windows,
+                                 chunks=2 if len(hops) % 2 == 0 else 1)[0])
+               for hb in resident]
+        want = [np.asarray(cls.run(hops, windows)[0])
+                for cls in (HopBatchedCC(log, max_steps=60),
+                            HopBatchedBFS(log, (0, 1), max_steps=60))]
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
