@@ -20,9 +20,11 @@ propagation, a workload class the reference has no analogue for.
 from .blockchain import (
     BitcoinBlockParser,
     ChainalysisABParser,
+    DashcoinBlockParser,
     EthereumDegreeRanking,
     EthereumTaintTracking,
     EthereumTransactionParser,
+    LitecoinBlockParser,
 )
 from .citations import CitationParser
 from .embeddings import TemporalEmbeddings
@@ -40,11 +42,13 @@ __all__ = [
     "GabUserGraphParser",
     "GabPostGraphParser",
     "GabMostUsedTopics",
+    "LitecoinBlockParser",
     "EthereumTransactionParser",
     "EthereumTaintTracking",
     "EthereumDegreeRanking",
     "BitcoinBlockParser",
     "ChainalysisABParser",
+    "DashcoinBlockParser",
     "LDBCParser",
     "CitationParser",
     "TrackAndTraceParser",

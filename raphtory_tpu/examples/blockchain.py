@@ -114,6 +114,15 @@ class BitcoinBlockParser(Parser):
         return out
 
 
+# Litecoin and Dashcoin expose Bitcoin's block-RPC JSON shape verbatim;
+# the reference's LitecoinRouter / DashcoinRouter are structural twins of
+# BitcoinRouter (examples/blockchain/routers/LitecoinRouter.scala,
+# DashcoinRouter.scala), so one parser class serves all three chains —
+# named here so each reference example resolves by its own name.
+LitecoinBlockParser = BitcoinBlockParser
+DashcoinBlockParser = BitcoinBlockParser
+
+
 class ChainalysisABParser(Parser):
     """``txid,srcCluster,dstCluster,btc,usd,time`` → two-leg payment path."""
 

@@ -28,6 +28,13 @@ class IngestionPipeline:
     def __init__(self, log: EventLog | None = None,
                  watermarks: WatermarkRegistry | None = None,
                  batch_size: int = 4096, queue_max_events: int = 0):
+        if log is not None and not hasattr(log, "append_batch"):
+            # catch TemporalGraph-for-EventLog misuse at construction —
+            # otherwise it surfaces as an AttributeError inside a consumer
+            # thread, long after the mistake
+            raise TypeError(
+                f"log must be an EventLog (got {type(log).__name__}); "
+                "pass graph.log, not the graph")
         self.log = log if log is not None else EventLog()
         self.watermarks = watermarks if watermarks is not None else WatermarkRegistry()
         self.batch_size = batch_size

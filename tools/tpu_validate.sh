@@ -50,12 +50,17 @@ if ! (run_cfg headline 900 && on_tpu /tmp/bench_headline_tpu.json); then
 fi
 if on_tpu /tmp/bench_headline_tpu.json; then
   cp /tmp/bench_headline_tpu.json /tmp/bench_headline_tpu_c3.json
+  echo "--- headline RTPU_CHUNKS=1 (tuning; own file) ---"
+  env RTPU_CHUNKS=1 ${RTPU_FOLD:+RTPU_FOLD=$RTPU_FOLD} timeout 600 \
+    $PY bench.py --config headline --no-crosscheck \
+    | tail -1 > /tmp/bench_headline_tpu_c1.json
+  echo "rc=$?"
+  on_tpu /tmp/bench_headline_tpu_c1.json \
+    || { echo "chunks=1 row not on device; discarding"; \
+         rm -f /tmp/bench_headline_tpu_c1.json; }
+else
+  echo "no on-device headline banked; skipping chunks=1 tuning run"
 fi
-echo "--- headline RTPU_CHUNKS=1 (tuning; own file) ---"
-env RTPU_CHUNKS=1 ${RTPU_FOLD:+RTPU_FOLD=$RTPU_FOLD} timeout 600 \
-  $PY bench.py --config headline --no-crosscheck \
-  | tail -1 | tee /tmp/bench_headline_tpu_c1.json
-echo "rc=${PIPESTATUS[0]}"
 
 # 2. scale_pagerank staged: small proof first (bounded tunnel exposure),
 # then the full default size with the chunked-retry uploads — ONLY once
@@ -77,11 +82,17 @@ if ! (run_cfg scale_pagerank 900 RTPU_SCALE_V=1000000 RTPU_SCALE_E=$((1<<22)) \
   fi
 fi
 if [ "$small_ok" = 1 ]; then
+  # bank the small on-device proof before the full run's tee can clobber it
+  cp /tmp/bench_scale_pagerank_tpu.json /tmp/bench_scale_pagerank_tpu_small.json
   run_cfg scale_pagerank 2700 ${RTPU_FOLD:+RTPU_FOLD=$RTPU_FOLD} \
       ${RTPU_SCALE_MASKS:+RTPU_SCALE_MASKS=$RTPU_SCALE_MASKS} \
     || echo "scale_pagerank failed on device"
 else
   echo "skipping full-size scale_pagerank: no small proof this pass"
+  # keep the suite's scale subprocesses at the proven-small size too —
+  # an unguarded full-size upload here is the wedge the staging avoids
+  export RTPU_SCALE_V=1000000 RTPU_SCALE_E=$((1<<22))
+  export RTPU_FEAT_V=$((1<<18)) RTPU_FEAT_E=$((1<<21))
 fi
 
 # 3. full suite at HEAD -> artifact (scale configs already subprocess-guarded)
