@@ -322,7 +322,8 @@ def bench_headline():
             ranks, steps = hb.run(hops, windows, chunks=n_chunks,
                                   warm_start=True)
             disp = _time.perf_counter() - s0
-            return ranks, {"disp": disp, "steps": int(steps)}
+            return ranks, {"disp": disp, "steps": int(steps),
+                           "ship": hb.ship_bytes}
 
         elapsed, repeats, aux = _best_of(once)
         vps = n_views / elapsed
@@ -340,6 +341,10 @@ def bench_headline():
             "device_wait_seconds": round(elapsed - aux["disp"], 3),
             "repeat_sweep_seconds": repeats,
             "supersteps": aux["steps"],
+            # fold-state payload of ONE timed sweep (static tables ship
+            # once per log and are excluded) — the resident-base design's
+            # whole point is keeping this O(base + deltas), chunk-reship-free
+            "h2d_ship_bytes_per_sweep": aux["ship"],
             "baseline": "reference per-view time 12.056s (README demo)",
         }
     except Exception as e:  # never lose the headline: per-hop fallback

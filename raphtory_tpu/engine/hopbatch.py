@@ -317,7 +317,7 @@ def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
                       windows, *, algo_args: tuple, seed_mask=None,
                       e_src_dev=None, e_dst_dev=None, r_init=None,
                       weight_base=None, weight_deltas=None,
-                      h0_delta: bool = False):
+                      h0_delta: bool = False, ship_counter=None):
     """Dispatch a delta-fed columnar kernel (``kind``: pagerank|cc|bfs)
     over ``_HopBatched._fold_deltas`` output; returns ``(result, steps,
     advanced_base)``. ``weight_base`` + ``weight_deltas`` ([(pos, val)]
@@ -346,6 +346,20 @@ def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
                              U_e, U_v, np.dtype(tdt).name,
                              r_init is not None, tuple(algo_args),
                              weighted, U_w, h0_delta)
+    if ship_counter is not None:
+        # FOLD-STATE host→device payload of THIS dispatch (padded shapes;
+        # device-resident inputs — h0 base, cached tables — ship nothing).
+        # O(C) column descriptors and per-dispatch seed masks are excluded
+        # on BOTH fold paths, so host-vs-delta numbers compare like for
+        # like (engine ship_bytes docstring).
+        shipped = [de_pos, de_lat, de_alive, dv_pos, dv_lat, dv_alive]
+        if not h0_delta:
+            shipped += [a for a in base]
+        if weighted:
+            shipped += [dw_pos, dw_val]
+            if not h0_delta:
+                shipped.append(weight_base)
+        ship_counter(int(sum(a.nbytes for a in shipped)))
     extra = []
     if seed_mask is not None:
         extra.append(seed_mask)
@@ -579,6 +593,12 @@ class _HopBatched:
         #: host seconds spent folding + writing columns in the LAST run()
         #: (callers report it as snapshot-build time)
         self.fold_seconds = 0.0
+        #: host→device FOLD-STATE payload bytes of the LAST run() — the
+        #: quantity the resident-base design exists to minimise. Excluded
+        #: on both fold paths, so comparisons are like for like: the
+        #: per-log static tables (ship once per log), O(C) column
+        #: descriptors, and per-dispatch seed masks.
+        self.ship_bytes = 0
         # static edge tables upload LAZILY on the first dispatch (callers
         # that only use the host fold — e.g. the column-sharded mesh
         # route — never pay the device transfer), then cache
@@ -610,6 +630,9 @@ class _HopBatched:
         if ship_base is None:
             return tuple(self._dev_base[:4]), True
         return ship_base, False
+
+    def _count_ship(self, nbytes: int) -> None:
+        self.ship_bytes += int(nbytes)
 
     def _run_delta(self, fn):
         """Run a delta dispatch and keep its advanced base device-resident;
@@ -670,6 +693,7 @@ class _HopBatched:
         steps when consecutive hops differ little). Warm-started results
         agree with cold ones to the solver tolerance, not bitwise."""
         self.fold_seconds = 0.0
+        self.ship_bytes = 0
         if warm_start and not self.supports_warm_start:
             raise ValueError(
                 f"{type(self).__name__} cannot warm-start: its superstep "
@@ -796,6 +820,8 @@ class _HopBatched:
                 v_lat[j, d["v_idx"]] = t.cast_times(d["v_lat"])
                 v_alive[j, d["v_idx"]] = d["v_alive"]
         self.fold_seconds += _time.perf_counter() - f0
+        self.ship_bytes += (e_lat.nbytes + e_alive.nbytes
+                            + v_lat.nbytes + v_alive.nbytes)
         return hop_times, (e_lat, e_alive, v_lat, v_alive)
 
     def _apply_delta_to_base(self):
@@ -908,7 +934,7 @@ class HopBatchedPageRank(_HopBatched):
             algo_args=(float(self.damping), float(self.tol),
                        int(self.max_steps)),
             e_src_dev=self._e_src, e_dst_dev=self._e_dst, r_init=r_init,
-            h0_delta=h0))
+            h0_delta=h0, ship_counter=self._count_ship))
 
 
 class HopBatchedBFS(_HopBatched):
@@ -940,7 +966,7 @@ class HopBatchedBFS(_HopBatched):
             hop_times, windows,
             algo_args=(int(self.max_steps), bool(self.directed)),
             seed_mask=_seed_mask(self.tables, self.seeds),
-            e_src_dev=self._e_src, e_dst_dev=self._e_dst, h0_delta=h0))
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst, h0_delta=h0, ship_counter=self._count_ship))
 
 
 class HopBatchedSSSP(HopBatchedBFS):
@@ -1026,7 +1052,9 @@ class HopBatchedSSSP(HopBatchedBFS):
 
     def _fold_columns(self, hop_times, hop_callback=None):
         hop_times, cols = super()._fold_columns(hop_times, hop_callback)
-        return hop_times, (*cols, self._weight_cols(hop_times))
+        wcols = self._weight_cols(hop_times)
+        self.ship_bytes += wcols.nbytes
+        return hop_times, (*cols, wcols)
 
     def _weight_deltas(self, hop_times, resident: bool = False):
         """Per-hop (pos, val) weight updates + the running state at hop 0
@@ -1088,7 +1116,7 @@ class HopBatchedSSSP(HopBatchedBFS):
             windows, algo_args=(int(self.max_steps), bool(self.directed)),
             seed_mask=_seed_mask(self.tables, self.seeds),
             e_src_dev=self._e_src, e_dst_dev=self._e_dst,
-            weight_base=w_base, weight_deltas=w_deltas, h0_delta=h0))
+            weight_base=w_base, weight_deltas=w_deltas, h0_delta=h0, ship_counter=self._count_ship))
 
 
 class HopBatchedCC(_HopBatched):
@@ -1108,7 +1136,7 @@ class HopBatchedCC(_HopBatched):
         return self._run_delta(lambda: run_columns_delta(
             "cc", self.tables, base, deltas_e, deltas_v,
             hop_times, windows, algo_args=(int(self.max_steps),),
-            e_src_dev=self._e_src, e_dst_dev=self._e_dst, h0_delta=h0))
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst, h0_delta=h0, ship_counter=self._count_ship))
 
     def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
         assert r_init is None   # guarded by supports_warm_start

@@ -533,3 +533,40 @@ def test_delta_fold_residency_drops_on_fold_failure(monkeypatch, seed):
     got, _ = hb.run([700, 900], [None])
     fresh, _ = HopBatchedCC(log, max_steps=30).run([700, 900], [None])
     np.testing.assert_array_equal(np.asarray(got), np.asarray(fresh))
+
+
+def test_ship_bytes_accounting(monkeypatch):
+    """ship_bytes reflects the resident-base design at realistic shapes
+    (hops covering a narrow late slice of a larger log, like the GAB
+    bench): the delta sweep ships base once + small pads vs the host
+    path's H full folds, and a follow-on batch on the live engine ships
+    no base at all."""
+    import numpy as np
+
+    from raphtory_tpu.engine.hopbatch import HopBatchedPageRank
+
+    # 2000 ids keeps per-vertex degree (and so delete killList fan-out,
+    # which legitimately inflates per-hop touched-pair deltas) moderate
+    rng = np.random.default_rng(31)
+    log = random_log(rng, n_events=40_000, n_ids=2_000, t_span=10_000)
+    hops = [8_500, 8_600, 8_700, 8_800]
+
+    monkeypatch.setenv("RTPU_FOLD", "host")
+    hb_host = HopBatchedPageRank(log, max_steps=4)
+    hb_host.run(hops, [3_000])
+    t = hb_host.tables
+    per_row = np.dtype(t.tdtype).itemsize + 1
+    base_bytes = (t.m_pad + t.n_pad) * per_row
+    assert hb_host.ship_bytes >= len(hops) * base_bytes
+
+    monkeypatch.setenv("RTPU_FOLD", "delta")
+    hb = HopBatchedPageRank(log, max_steps=4)
+    hb.run(hops, [3_000], chunks=2, warm_start=True)
+    # base ships once (chunk 1 only) + per-hop pads — under the H folds
+    # the host path ships
+    run1 = hb.ship_bytes
+    assert 0 < run1 < hb_host.ship_bytes
+    # a follow-on batch on the live engine is all-delta: no base at all,
+    # so it ships less than one base snapshot (and less than run 1)
+    hb.run([8_900, 9_000], [3_000])
+    assert hb.ship_bytes < base_bytes and hb.ship_bytes < run1
