@@ -946,9 +946,25 @@ class HopBatchedBFS(_HopBatched):
     def __init__(self, log: EventLog, seeds, directed: bool = False,
                  max_steps: int = 100):
         super().__init__(log)
-        self.seeds = tuple(seeds)
+        self._seeds = tuple(seeds)
         self.directed = directed
         self.max_steps = max_steps
+        # seeds are fixed per engine: upload the dense seed mask once so
+        # chunked/resident sweeps don't re-ship an n_pad bool per dispatch
+        self._seed_dev = None
+
+    @property
+    def seeds(self):
+        """Seed vertex ids — fixed at construction (the device seed mask
+        is cached; build a new engine for different seeds)."""
+        return self._seeds
+
+    @property
+    def _seed(self):
+        if self._seed_dev is None:
+            self._seed_dev = jnp.asarray(_seed_mask(self.tables,
+                                                    self.seeds))
+        return self._seed_dev
 
     def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
         assert r_init is None   # guarded by supports_warm_start
@@ -965,7 +981,7 @@ class HopBatchedBFS(_HopBatched):
             "bfs", self.tables, base, deltas_e, deltas_v,
             hop_times, windows,
             algo_args=(int(self.max_steps), bool(self.directed)),
-            seed_mask=_seed_mask(self.tables, self.seeds),
+            seed_mask=self._seed,
             e_src_dev=self._e_src, e_dst_dev=self._e_dst, h0_delta=h0, ship_counter=self._count_ship))
 
 
@@ -1114,7 +1130,7 @@ class HopBatchedSSSP(HopBatchedBFS):
         return self._run_delta(lambda: run_columns_delta(
             "bfs", self.tables, base, deltas_e, deltas_v, hop_times,
             windows, algo_args=(int(self.max_steps), bool(self.directed)),
-            seed_mask=_seed_mask(self.tables, self.seeds),
+            seed_mask=self._seed,
             e_src_dev=self._e_src, e_dst_dev=self._e_dst,
             weight_base=w_base, weight_deltas=w_deltas, h0_delta=h0, ship_counter=self._count_ship))
 
