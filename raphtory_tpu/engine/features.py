@@ -34,11 +34,18 @@ from .device_sweep import DeviceSweep
 
 @functools.lru_cache(maxsize=64)
 def _compiled_propagate(n_pad: int, m_pad: int, chunk: int, F: int,
-                        rounds: int, self_weight: float, tdt: str):
+                        rounds: int, self_weight: float, tdt: str,
+                        fdt: str = "float32"):
+    """``fdt`` is the feature STORAGE dtype: bfloat16 halves the HBM bytes
+    of the per-edge row gathers (the term this engine is bound by on TPU)
+    while accumulation, degree-normalise and the L2 norm stay float32 —
+    the standard mixed-precision aggregation recipe."""
     tdt = jnp.dtype(tdt)
+    fdt = jnp.dtype(fdt)
     C = m_pad // chunk
 
     def propagate(X, e_src, e_dst, e_lat, e_alive, time, window):
+        X = X.astype(fdt)
         info = jnp.iinfo(tdt)
         lo = jnp.clip(time - window, info.min, info.max).astype(tdt)
         mask = e_alive & ((window < 0) | (e_lat >= lo))   # [m_pad]
@@ -62,7 +69,10 @@ def _compiled_propagate(n_pad: int, m_pad: int, chunk: int, F: int,
         def one_round(H, _):
             def chunk_body(agg, ins):
                 s, d, mk = ins
-                G = jnp.where(mk[:, None], H[s, :], 0.0)     # row-tile gather
+                # gather reads fdt rows from HBM; the f32 convert happens
+                # in-flight, so bf16 storage halves the streamed bytes
+                G = jnp.where(mk[:, None], H[s, :].astype(jnp.float32),
+                              0.0)
                 return agg + jax.ops.segment_sum(
                     G, d, num_segments=n_pad, indices_are_sorted=True), None
 
@@ -70,10 +80,11 @@ def _compiled_propagate(n_pad: int, m_pad: int, chunk: int, F: int,
                 chunk_body, jnp.zeros((n_pad, F), jnp.float32),
                 (src_c, dst_c, msk_c))
             H2 = agg * inv_deg[:, None]
-            H2 = self_weight * H + (1.0 - self_weight) * H2
+            H2 = self_weight * H.astype(jnp.float32) \
+                + (1.0 - self_weight) * H2
             # row L2 normalise keeps magnitudes bounded across rounds
             norm = jnp.sqrt(jnp.sum(H2 * H2, axis=1, keepdims=True))
-            return H2 / jnp.maximum(norm, 1e-12), None
+            return (H2 / jnp.maximum(norm, 1e-12)).astype(fdt), None
 
         H, _ = jax.lax.scan(one_round, X, None, length=rounds)
         return H
@@ -89,7 +100,8 @@ class FeatureAggregator:
     sweep's global dense vertex space (``ds.uv``)."""
 
     def __init__(self, ds: DeviceSweep, feature_dim: int = 128,
-                 chunk: int = 1 << 22, self_weight: float = 0.5):
+                 chunk: int = 1 << 22, self_weight: float = 0.5,
+                 dtype: str = "float32"):
         self.ds = ds
         self.F = feature_dim
         # chunk must divide m_pad; shrink to m_pad when the graph is small
@@ -97,12 +109,16 @@ class FeatureAggregator:
         while ds.m_pad % self.chunk:
             self.chunk //= 2
         self.self_weight = float(self_weight)
+        # feature storage dtype: "bfloat16" halves the HBM-bound row
+        # traffic on TPU; accumulation stays float32 (_compiled_propagate)
+        self.dtype = jnp.dtype(dtype)
 
     def random_features(self, seed: int = 0):
         """Deterministic on-device init (unit-norm rows) — no host transfer."""
         X = jax.random.normal(jax.random.PRNGKey(seed),
                               (self.ds.n_pad, self.F), jnp.float32)
-        return X / jnp.linalg.norm(X, axis=1, keepdims=True)
+        return (X / jnp.linalg.norm(X, axis=1, keepdims=True)) \
+            .astype(self.dtype)
 
     def propagate(self, X, time: int | None = None, *,
                   window: int | None = None, rounds: int = 2):
@@ -113,7 +129,7 @@ class FeatureAggregator:
             raise ValueError("advance the sweep (or pass time=) first")
         fn = _compiled_propagate(
             ds.n_pad, ds.m_pad, self.chunk, self.F, int(rounds),
-            self.self_weight, np.dtype(ds.tdtype).name)
+            self.self_weight, np.dtype(ds.tdtype).name, self.dtype.name)
         v_lat, v_alive, v_first, e_lat, e_alive, e_first = ds._bufs
         return fn(X, ds.e_src, ds.e_dst, e_lat, e_alive,
                   jnp.asarray(ds.t_now, jnp.int64),
@@ -125,8 +141,9 @@ class FeatureAggregator:
         reporting): per round, the edge axis streams a gathered F-row and
         writes it once into the accumulator, plus index/mask columns; the
         masked-degree pass runs ONCE per call (round-invariant)."""
-        per_edge = 2 * self.F * 4 + 2 * 4 + 1   # gather+scatter rows, ids, mask
-        per_vertex = 3 * self.F * 4             # acc read+write, H read
+        fb = self.dtype.itemsize                # feature storage bytes/lane
+        per_edge = self.F * (fb + 4) + 2 * 4 + 1  # fdt gather + f32 scatter
+        per_vertex = self.F * (2 * 4 + fb)      # f32 acc read+write, fdt H
         deg_pass = self.ds.m_pad * (4 + 1)      # dst ids + mask, one pass
         return deg_pass + rounds * (self.ds.m_pad * per_edge
                                     + self.ds.n_pad * per_vertex)
