@@ -871,8 +871,9 @@ def bench_scale_features():
 
     rounds, F = 2, 128
     # feature storage dtype: bf16 on the accelerator (halves the HBM-bound
-    # row traffic; f32 accumulation), f32 on host where bf16 is emulated.
-    # The same-size crosscheck pins the SAME dtype for a fair comparison.
+    # row traffic; f32 accumulation), f32 on host where bf16 is emulated —
+    # each backend's NATIVE dtype, disclosed in the row; an explicit
+    # RTPU_FEAT_DTYPE pins both (it propagates to the crosscheck child).
     fdt = os.environ.get(
         "RTPU_FEAT_DTYPE",
         "bfloat16" if os.environ.get("RTPU_BENCH_DEVICE") not in
@@ -990,10 +991,14 @@ def _cpu_crosscheck(config: str = "headline", timeout: float = 420.0,
         # a mislabelled crosscheck would fake the TPU-vs-CPU proof
         return {"error": "crosscheck subprocess ran on "
                          f"{row.get('device')!r}, not cpu"}
-    return {"value": row.get("value"), "unit": row.get("unit"),
-            "device": row.get("device"),
-            "sweep_seconds": row.get("detail", {}).get("sweep_seconds"),
-            "engine": row.get("detail", {}).get("engine")}
+    out = {"value": row.get("value"), "unit": row.get("unit"),
+           "device": row.get("device"),
+           "sweep_seconds": row.get("detail", {}).get("sweep_seconds"),
+           "engine": row.get("detail", {}).get("engine")}
+    fdt = row.get("detail", {}).get("feature_dtype")
+    if fdt is not None:   # which dtype produced the host number
+        out["feature_dtype"] = fdt
+    return out
 
 
 def main():

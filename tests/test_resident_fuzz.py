@@ -1,6 +1,8 @@
 """Fuzz the warm View path's staleness logic: random interleavings of
 appends and View queries must always match a cold rebuild."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -112,3 +114,34 @@ def test_hopbatch_resident_fuzz(monkeypatch, seed):
                             HopBatchedBFS(log, (0, 1), max_steps=60))]
         for g, w in zip(got, want):
             np.testing.assert_array_equal(g, w)
+
+
+@pytest.mark.skipif(not os.environ.get("RTPU_SLOW_TESTS"),
+                    reason="extended fuzz: set RTPU_SLOW_TESTS=1")
+@pytest.mark.parametrize("seed", range(100, 130))
+def test_hopbatch_resident_fuzz_extended(monkeypatch, seed):
+    """30-seed deep fuzz of the resident delta base (opt-in: ~15s/seed):
+    3 engines x random multi-batch sweeps vs fresh engines, bitwise."""
+    from raphtory_tpu.engine.hopbatch import (HopBatchedBFS, HopBatchedCC,
+                                              HopBatchedPageRank)
+    from test_sweep import random_log
+
+    monkeypatch.setenv("RTPU_FOLD", "delta")
+    rng = np.random.default_rng(seed)
+    log = random_log(rng, n_events=700, n_ids=30, t_span=2000, props=True)
+    cuts = np.sort(rng.choice(np.arange(100, 2000, 40),
+                              size=rng.integers(4, 10), replace=False))
+    k = int(rng.integers(2, 4))
+    batches = [list(c) for c in np.array_split(cuts, k) if len(c)]
+    windows = [int(rng.integers(50, 2500)), None]
+    mks = [lambda: HopBatchedCC(log, max_steps=60),
+           lambda: HopBatchedBFS(log, (0, 1), max_steps=60),
+           lambda: HopBatchedPageRank(log, tol=0.0, max_steps=6)]
+    res = [mk() for mk in mks]
+    for hops in batches:
+        ch = 2 if len(hops) % 2 == 0 else 1
+        for hb, mk in zip(res, mks):
+            got = np.asarray(hb.run(hops, windows, chunks=ch)[0])
+            want = np.asarray(mk().run(hops, windows)[0])
+            np.testing.assert_array_equal(got, want, err_msg=str(
+                (seed, type(hb).__name__, hops)))
