@@ -116,7 +116,7 @@ def test_hopbatch_resident_fuzz(monkeypatch, seed):
             np.testing.assert_array_equal(g, w)
 
 
-@pytest.mark.skipif(not os.environ.get("RTPU_SLOW_TESTS"),
+@pytest.mark.skipif(os.environ.get("RTPU_SLOW_TESTS") != "1",
                     reason="extended fuzz: set RTPU_SLOW_TESTS=1")
 @pytest.mark.parametrize("seed", range(100, 130))
 def test_hopbatch_resident_fuzz_extended(monkeypatch, seed):
