@@ -16,5 +16,5 @@ while true; do
   else
     echo "$(date -u +%H:%M:%S) tpu down" >> $LOG
   fi
-  sleep 240
+  sleep 120
 done
