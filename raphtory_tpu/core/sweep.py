@@ -56,6 +56,62 @@ def _vfold_pool():
             max_workers=2, thread_name_prefix="sweep-vfold")
     return _VFOLD_POOL
 
+
+_PREFETCH_POOL = None
+
+
+def _prefetch_pool():
+    """Process-wide single worker for hop-lookahead prefetchers
+    (``engine/device_sweep.run_sweep``, ``engine/hopbatch._run_chunks``):
+    hop *i+1*'s host fold + delta staging runs here while hop *i*'s
+    compiled superstep runs on device — the fold → stage → ship → compute
+    pipeline. SINGLE worker by design: a fold mutates shared SweepBuilder
+    state, so at most one may be in flight. Deliberately separate from
+    ``_vfold_pool`` — the fold task BLOCKS on its inner vertex fold, and
+    sharing a pool would let it occupy the very worker that inner task
+    needs (classic nested-submit deadlock)."""
+    global _PREFETCH_POOL
+    if _PREFETCH_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _PREFETCH_POOL = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="sweep-prefetch")
+    return _PREFETCH_POOL
+
+
+def prefetch_map(fold_fns, body) -> None:
+    """Drive ``fold_fns`` (zero-arg callables) through the prefetch worker
+    with one-deep lookahead, calling ``body(payload, stall_seconds)`` for
+    each fold's result while the NEXT fold already runs in the worker —
+    the body (ship + device dispatch) overlaps the following fold.
+    ``stall_seconds`` is how long the driver actually WAITED on the fold
+    (0 = it hid entirely behind the previous body). If a fold or a body
+    raises, the in-flight fold is drained SYNCHRONOUSLY before the
+    exception propagates — folds mutate shared sweep state, and the
+    caller's error handler must not reset that state under a
+    still-running fold. The single concurrency-pattern copy both sweep
+    engines pipeline through (a generator can't give this guarantee: its
+    finally would only drain at finalisation, which the propagating
+    traceback's frame references delay past the caller's handler)."""
+    import time as _t
+
+    fns = list(fold_fns)
+    if not fns:
+        return
+    pool = _prefetch_pool()
+    fut = pool.submit(fns[0])
+    try:
+        for i in range(len(fns)):
+            t0 = _t.perf_counter()
+            payload = fut.result()
+            stall = _t.perf_counter() - t0
+            fut = pool.submit(fns[i + 1]) if i + 1 < len(fns) else None
+            body(payload, stall)
+    except BaseException:
+        if fut is not None:   # let the in-flight fold finish first
+            fut.exception()
+        raise
+
 _EMPTY_DELTA = {
     "v_idx": np.empty(0, np.int64), "v_lat": np.empty(0, np.int64),
     "v_alive": np.empty(0, bool), "v_first": np.empty(0, np.int64),

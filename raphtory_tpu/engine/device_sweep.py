@@ -36,6 +36,7 @@ such programs fall back to the ``bsp`` path, see ``supported()``).
 from __future__ import annotations
 
 import functools
+import time as _time
 import weakref
 
 import jax
@@ -280,52 +281,127 @@ class DeviceSweep:
         self.cap_v = max(1024, self.n_pad // 4)
         self.cap_e = max(4096, self.m_pad // 16)
         self.t_now: int | None = None
+        #: host seconds spent folding + staging (includes worker-thread time
+        #: when run_sweep pipelines) and fold-state bytes staged for H2D
+        self.fold_seconds = 0.0
+        self.ship_bytes = 0
+        #: run_sweep only: seconds the dispatch loop spent WAITING on the
+        #: lookahead fold — 0 means the fold fully hid behind device compute
+        self.fold_stall_seconds = 0.0
+        # a failure between fold and device apply leaves t_now ahead of
+        # _bufs (the lookahead fold may even have advanced PAST the failed
+        # hop) — the next fold must take the full-refresh path, never the
+        # time==t_now noop or a delta scatter onto stale buffers
+        self._stale = False
 
     # ---- sweep driving ----
 
     def advance(self, time: int) -> None:
         """Fold events in (t_now, time] on host and mirror the touched rows
         into the device buffers. Times must be non-decreasing."""
+        self._apply_staged(self._fold_hop(time))
+
+    def _fold_hop(self, time: int) -> dict:
+        """Host half of one hop: fold events in (t_now, time] and STAGE the
+        touched rows as padded contiguous arrays, ready to ship. Pure
+        numpy — safe to run in the prefetch worker while the previous
+        hop's scatter + superstep run on device. The returned payload
+        carries its own hop time (``self.t_now`` keeps moving under a
+        lookahead fold)."""
+        f0 = _time.perf_counter()
         time = int(time)
         if self.t_now is not None and time < self.t_now:
             raise ValueError(
                 f"DeviceSweep times must ascend (got {time} < {self.t_now})")
-        if self.t_now is not None and time == self.t_now:
-            return
-        self.sw._advance(time)
-        self.t_now = time
+        advanced = self.t_now is None or time > self.t_now
+        if advanced:
+            self.sw._advance(time)
+            self.t_now = time
+        if self._stale:
+            # recover from an aborted earlier hop: re-stage the FULL fold
+            # state (the running sw is authoritative; the device buffers
+            # are behind by an unknown number of hops). Cleared here —
+            # a failed apply re-marks stale before the error propagates.
+            self._stale = False
+            payload = {"time": time, "kind": "full",
+                       "arrays": self._stage_full()}
+            self.fold_seconds += _time.perf_counter() - f0
+            return payload
+        if not advanced:   # repeat hop on healthy buffers: nothing to ship
+            return {"time": time, "kind": "noop"}
         d = self.sw.last_delta
         nv, ne = len(d["v_idx"]), len(d["e_enc"])
         if nv == 0 and ne == 0:
-            return
+            self.fold_seconds += _time.perf_counter() - f0
+            return {"time": time, "kind": "noop"}
         # full-state refresh (first hop, or a delta so large that chunked
         # scatters would ship more than the whole buffers): host-assemble and
         # device_put — one transfer, no scatter program involved
         if nv > self.n_pad // 2 or ne > self.m_pad // 2:
-            self._refresh_full()
+            payload = {"time": time, "kind": "full",
+                       "arrays": self._stage_full()}
+        else:
+            e_pos = self.tables.eng_pos(d["e_enc"])
+            n_chunks = max(-(-nv // self.cap_v), -(-ne // self.cap_e), 1)
+            chunks = []
+            for i in range(n_chunks):
+                ov, oe = i * self.cap_v, i * self.cap_e
+                # out-of-range slices are empty; pad rows scatter out of
+                # bounds and are dropped
+                chunks.append(self._stage_chunk(
+                    d["v_idx"][ov: ov + self.cap_v],
+                    d["v_lat"][ov: ov + self.cap_v],
+                    d["v_alive"][ov: ov + self.cap_v],
+                    d["v_first"][ov: ov + self.cap_v],
+                    e_pos[oe: oe + self.cap_e],
+                    d["e_lat"][oe: oe + self.cap_e],
+                    d["e_alive"][oe: oe + self.cap_e],
+                    d["e_first"][oe: oe + self.cap_e],
+                ))
+            payload = {"time": time, "kind": "chunks", "chunks": chunks}
+        self.fold_seconds += _time.perf_counter() - f0
+        return payload
+
+    def _apply_staged(self, payload: dict) -> None:
+        """Device half of one hop: ship the staged arrays and scatter them
+        into the donated resident buffers (or swap in a full refresh).
+        Runs on the dispatch thread; all device ops are async."""
+        kind = payload["kind"]
+        if kind == "noop":
             return
-        e_pos = self.tables.eng_pos(d["e_enc"])
-        n_chunks = max(-(-nv // self.cap_v), -(-ne // self.cap_e), 1)
-        for i in range(n_chunks):
-            ov, oe = i * self.cap_v, i * self.cap_e
-            # out-of-range slices are empty; pad rows scatter out of bounds
-            # and are dropped
-            self._apply_chunk(
-                d["v_idx"][ov: ov + self.cap_v],
-                d["v_lat"][ov: ov + self.cap_v],
-                d["v_alive"][ov: ov + self.cap_v],
-                d["v_first"][ov: ov + self.cap_v],
-                e_pos[oe: oe + self.cap_e],
-                d["e_lat"][oe: oe + self.cap_e],
-                d["e_alive"][oe: oe + self.cap_e],
-                d["e_first"][oe: oe + self.cap_e],
-            )
+        from ..utils.transfer import shared_engine
+
+        try:
+            if kind == "full":
+                arrays = payload["arrays"]
+                self.ship_bytes += sum(a.nbytes for a in arrays)
+                self._bufs = tuple(shared_engine().put_many(arrays))
+                return
+            apply_fn = _compiled_apply(self.cap_v, self.cap_e,
+                                       np.dtype(self.tdtype).name)
+            for chunk in payload["chunks"]:
+                self.ship_bytes += sum(a.nbytes for a in chunk)
+                # resident state flows through donated buffers
+                # (donate_argnums 0-5 in _compiled_apply) — the
+                # double-buffer swap XLA gives us for free; only the
+                # O(delta) staged rows cross the link
+                self._bufs = apply_fn(
+                    *self._bufs, *shared_engine().put_many(list(chunk)))
+        except BaseException:
+            # t_now already reflects this payload's fold but the buffers
+            # don't (and a donated apply may have consumed them) — the
+            # next fold must take the full-refresh path
+            self._stale = True
+            raise
 
     def _cast_t(self, a: np.ndarray) -> np.ndarray:
         return self.tables.cast_times(a)
 
-    def _apply_chunk(self, v_idx, v_lat, v_alive, v_first,
-                     e_idx, e_lat, e_alive, e_first) -> None:
+    def _stage_chunk(self, v_idx, v_lat, v_alive, v_first,
+                     e_idx, e_lat, e_alive, e_first) -> tuple:
+        """Pad one delta chunk to the fixed scatter capacities — fresh
+        contiguous arrays each hop (a reused staging buffer could alias
+        the device copy on the CPU backend)."""
         def pad(a, cap, dtype):
             # pad indices with a huge POSITIVE out-of-bounds value — negative
             # indices would wrap Python-style instead of being dropped
@@ -334,19 +410,25 @@ class DeviceSweep:
             return out
 
         tdt = self.tdtype
-        self._bufs = _compiled_apply(self.cap_v, self.cap_e, np.dtype(tdt).name)(
-            *self._bufs,
-            jnp.asarray(pad(v_idx, self.cap_v, np.int32)),
-            jnp.asarray(pad(self._cast_t(v_lat), self.cap_v, tdt)),
-            jnp.asarray(pad(v_alive, self.cap_v, bool)),
-            jnp.asarray(pad(self._cast_t(v_first), self.cap_v, tdt)),
-            jnp.asarray(pad(e_idx, self.cap_e, np.int32)),
-            jnp.asarray(pad(self._cast_t(e_lat), self.cap_e, tdt)),
-            jnp.asarray(pad(e_alive, self.cap_e, bool)),
-            jnp.asarray(pad(self._cast_t(e_first), self.cap_e, tdt)),
+        return (
+            pad(v_idx, self.cap_v, np.int32),
+            pad(self._cast_t(v_lat), self.cap_v, tdt),
+            pad(v_alive, self.cap_v, bool),
+            pad(self._cast_t(v_first), self.cap_v, tdt),
+            pad(e_idx, self.cap_e, np.int32),
+            pad(self._cast_t(e_lat), self.cap_e, tdt),
+            pad(e_alive, self.cap_e, bool),
+            pad(self._cast_t(e_first), self.cap_e, tdt),
         )
 
-    def _refresh_full(self) -> None:
+    def _apply_chunk(self, v_idx, v_lat, v_alive, v_first,
+                     e_idx, e_lat, e_alive, e_first) -> None:
+        self._apply_staged({"time": self.t_now, "kind": "chunks",
+                            "chunks": [self._stage_chunk(
+                                v_idx, v_lat, v_alive, v_first,
+                                e_idx, e_lat, e_alive, e_first)]})
+
+    def _stage_full(self) -> tuple:
         sw = self.sw
         tdt = self.tdtype
         v_lat = np.full(self.n_pad, self._tmin, tdt)
@@ -362,8 +444,11 @@ class DeviceSweep:
         e_lat[pos] = self._cast_t(sw.e_lat)
         e_alive[pos] = sw.e_alive
         e_first[pos] = self._cast_t(sw.e_first)
-        self._bufs = tuple(jnp.asarray(a) for a in
-                           (v_lat, v_alive, v_first, e_lat, e_alive, e_first))
+        return (v_lat, v_alive, v_first, e_lat, e_alive, e_first)
+
+    def _refresh_full(self) -> None:
+        self._apply_staged({"time": self.t_now, "kind": "full",
+                            "arrays": self._stage_full()})
 
     # ---- program dispatch ----
 
@@ -379,6 +464,12 @@ class DeviceSweep:
             self.advance(time)
         if self.t_now is None:
             raise ValueError("call advance(T) (or pass time=) before run()")
+        return self._dispatch(program, self.t_now, window, windows)
+
+    def _dispatch(self, program: VertexProgram, T: int, window, windows):
+        """Dispatch `program` against the CURRENT resident buffers for hop
+        time ``T`` — split from ``run`` so the pipelined sweep can dispatch
+        hop *i* while a lookahead fold has already moved ``t_now`` on."""
         batched = windows is not None
         if windows is not None and len(windows) == 0:
             raise ValueError("windows must be a non-empty list")
@@ -390,8 +481,73 @@ class DeviceSweep:
                                np.dtype(self.tdtype).name)
         result, steps = runner(
             *self._bufs, self.vids, self.e_src, self.e_dst,
-            jnp.asarray(self.t_now, jnp.int64),
+            jnp.asarray(int(T), jnp.int64),
             jnp.asarray(wlist, jnp.int64))
         if not batched:
             result = jax.tree_util.tree_map(lambda a: a[0], result)
         return result, steps
+
+    def run_sweep(self, program: VertexProgram, times, *,
+                  window: int | None = None, windows=None,
+                  prefetch: bool | None = None):
+        """Pipelined ascending range sweep: hop *i+1*'s host fold + delta
+        staging run in the prefetch worker while hop *i*'s staged rows
+        ship and its superstep computes — the fold → stage → ship →
+        compute pipeline (``core/sweep._prefetch_pool`` is the fold/stage
+        lane; resident state advances through donated device buffers and
+        never copies). Returns ``(results, steps_list)`` where
+        ``results[i]`` is ``run(program, times[i])``'s result — identical
+        to the serial loop (tested) and independent of the pipeline depth.
+        ``prefetch=False`` degrades to the serial advance/run loop (the
+        bench comparison point); the default follows the ``RTPU_PREFETCH``
+        kill-switch (on unless ``0`` — the same knob as the hopbatch
+        engine)."""
+        if prefetch is None:
+            import os
+
+            prefetch = os.environ.get("RTPU_PREFETCH", "1") != "0"
+        if not supported(program):
+            raise ValueError(
+                "program needs occurrences or host-materialised properties — "
+                "run it through bsp.run / jobs instead")
+        times = [int(t) for t in times]
+        if sorted(times) != times:
+            raise ValueError("run_sweep times must ascend")
+        # per-sweep telemetry (advance() outside run_sweep still
+        # accumulates into fold_seconds/ship_bytes; each sweep reports
+        # its own numbers, like hopbatch's run())
+        self.fold_seconds = 0.0
+        self.fold_stall_seconds = 0.0
+        self.ship_bytes = 0
+        results, steps = [], []
+        if not prefetch or len(times) <= 1:
+            for T in times:
+                self.advance(T)
+                r, s = self._dispatch(program, T, window, windows)
+                results.append(r)
+                steps.append(s)
+            return results, steps
+        import functools as _ft
+
+        from ..core.sweep import prefetch_map
+        from ..utils.transfer import _metrics
+
+        def step(payload, stall):
+            self.fold_stall_seconds += stall
+            m = _metrics()
+            if m is not None:
+                m.h2d_stall_seconds.labels(stage="fold").inc(stall)
+            self._apply_staged(payload)
+            r, s = self._dispatch(program, payload["time"], window, windows)
+            results.append(r)
+            steps.append(s)
+
+        try:
+            prefetch_map((_ft.partial(self._fold_hop, T) for T in times),
+                         step)
+        except BaseException:
+            # the lookahead fold may have advanced t_now past the hop whose
+            # dispatch failed — buffers are behind the clock now
+            self._stale = True
+            raise
+        return results, steps

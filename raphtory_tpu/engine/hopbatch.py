@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import functools
 import time as _time
-import weakref
 
 import jax
 import jax.numpy as jnp
@@ -98,6 +97,17 @@ def _masks_from_deltas(tdt, H: int, W: int,
     return me, mv, (fe_lat, fe_alive, fv_lat, fv_alive)
 
 
+def _tile_budget_bytes() -> int:
+    """Resolved ``RTPU_TILE_BUDGET_MB`` in bytes. Every columnar dispatcher
+    resolves this ONCE per call and threads it into the lru_cached compiled
+    factories, so the budget is part of the program cache key — changing
+    the env var mid-process recompiles instead of silently reusing
+    programs tiled for the old budget."""
+    import os
+
+    return int(os.environ.get("RTPU_TILE_BUDGET_MB", 256)) << 20
+
+
 def _edge_tile_for(m_pad: int, C: int, budget_bytes: int | None = None) -> int | None:
     """Edge-tile length for the columnar kernels, or None for single-shot.
 
@@ -109,9 +119,7 @@ def _edge_tile_for(m_pad: int, C: int, budget_bytes: int | None = None) -> int |
     ``lax.scan`` over equal tiles (plus one remainder slice, so no
     divisibility gymnastics) whose transient is ``tile * C * 4`` bytes."""
     if budget_bytes is None:
-        import os
-
-        budget_bytes = int(os.environ.get("RTPU_TILE_BUDGET_MB", 256)) << 20
+        budget_bytes = _tile_budget_bytes()
     if m_pad * C * 4 <= budget_bytes or m_pad <= (1 << 16):
         return None
     step = 1 << 16
@@ -120,7 +128,8 @@ def _edge_tile_for(m_pad: int, C: int, budget_bytes: int | None = None) -> int |
 
 
 def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
-                      tol: float, max_steps: int, r_init=None):
+                      tol: float, max_steps: int, r_init=None,
+                      tile_budget: int | None = None):
     """Power iteration over per-column masks ``me [m_pad, C]`` /
     ``mv [n_pad, C]`` — dangling redistribution, tol halting with
     converged-column freeze; semantics of ``algorithms/pagerank.py``.
@@ -138,7 +147,7 @@ def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
     # f32 view of the mask and the per-iteration gather payload are both
     # [m_pad, C] transients that at 28M pairs x 128 columns outgrow a
     # v5e's HBM — the resulting spill, not compute, bound the scale sweep.
-    tile = _edge_tile_for(e_src.shape[0], C)
+    tile = _edge_tile_for(e_src.shape[0], C, tile_budget)
     if tile is not None:
         n_main = (e_src.shape[0] // tile) * tile
         main = (e_src[:n_main].reshape(-1, tile),
@@ -225,7 +234,8 @@ def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
 
 @functools.lru_cache(maxsize=64)
 def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
-              tol: float, max_steps: int, tdt: str, warm: bool = False):
+              tol: float, max_steps: int, tdt: str, warm: bool = False,
+              tile_budget: int | None = None):
     tdt = jnp.dtype(tdt)
 
     def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
@@ -237,7 +247,8 @@ def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
         W = C // H
         r0 = jnp.tile(rest[0][-W:], (H, 1)).T if warm else None
         return _pagerank_columns(me, mv, e_src, e_dst, n_pad,
-                                 damping, tol, max_steps, r_init=r0)
+                                 damping, tol, max_steps, r_init=r0,
+                                 tile_budget=tile_budget)
 
     return jax.jit(run)
 
@@ -246,7 +257,8 @@ def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
 def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
                     U_e: int, U_v: int, tdt: str, warm: bool,
                     algo_args: tuple, weighted: bool = False,
-                    U_w: int = 0, h0: bool = False):
+                    U_w: int = 0, h0: bool = False,
+                    tile_budget: int | None = None):
     """Delta-fed columnar kernels: masks rebuilt on device from base state
     + per-hop deltas (``_masks_from_deltas``), then the shared algorithm
     body. ``kind``: pagerank | cc | bfs (``weighted`` adds a per-pair
@@ -272,11 +284,12 @@ def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
             r0 = jnp.tile(rest[0][-W:], (H, 1)).T if warm else None
             out, steps = _pagerank_columns(
                 me, mv, e_src, e_dst, n_pad, damping, tol, max_steps,
-                r_init=r0)
+                r_init=r0, tile_budget=tile_budget)
             return out, steps, adv
         if kind == "cc":
             (max_steps,) = algo_args
-            out, steps = _cc_columns(me, mv, e_src, e_dst, n_pad, max_steps)
+            out, steps = _cc_columns(me, mv, e_src, e_dst, n_pad, max_steps,
+                                     tile_budget=tile_budget)
             return out, steps, adv
         max_steps, directed = algo_args
         ew = 1.0
@@ -291,7 +304,8 @@ def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
             ew = jnp.concatenate(cols, axis=1)   # [m_pad, C] hop-major
             adv = adv + (cur_w,)
         out, steps = _bfs_columns(me, mv, e_src, e_dst, n_pad, max_steps,
-                                  directed, rest[0], ew)  # rest[0]: seeds
+                                  directed, rest[0], ew,  # rest[0]: seeds
+                                  tile_budget=tile_budget)
         return out, steps, adv
 
     return jax.jit(run)
@@ -345,7 +359,7 @@ def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
     runner = _compiled_delta(kind, tables.n_pad, tables.m_pad, H, W,
                              U_e, U_v, np.dtype(tdt).name,
                              r_init is not None, tuple(algo_args),
-                             weighted, U_w, h0_delta)
+                             weighted, U_w, h0_delta, _tile_budget_bytes())
     if ship_counter is not None:
         # FOLD-STATE host→device payload of THIS dispatch (padded shapes;
         # device-resident inputs — h0 base, cached tables — ship nothing).
@@ -367,14 +381,17 @@ def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
         extra.extend((weight_base, dw_pos, dw_val))
     if r_init is not None:
         extra.append(r_init)
-    return runner(
-        e_src_dev if e_src_dev is not None else jnp.asarray(tables.e_src),
-        e_dst_dev if e_dst_dev is not None else jnp.asarray(tables.e_dst),
-        *(jnp.asarray(a) for a in (be_lat, be_alive, bv_lat, bv_alive,
-                                   de_pos, de_lat, de_alive,
-                                   dv_pos, dv_lat, dv_alive,
-                                   T_col, w_col)),
-        *(jnp.asarray(a) for a in extra))
+    # the whole dispatch payload ships through the pipelined engine: array
+    # k+1 stages while k is on the wire, each slice retried on transport
+    # errors (device-resident inputs pass through untouched)
+    from ..utils.transfer import shared_engine
+
+    return runner(*shared_engine().put_many([
+        e_src_dev if e_src_dev is not None else tables.e_src,
+        e_dst_dev if e_dst_dev is not None else tables.e_dst,
+        be_lat, be_alive, bv_lat, bv_alive,
+        de_pos, de_lat, de_alive, dv_pos, dv_lat, dv_alive,
+        T_col, w_col, *extra]))
 
 
 def _edge_accumulate(seg, payload_of, combine, init, e_from, e_to, me, ew,
@@ -411,7 +428,8 @@ def _edge_accumulate(seg, payload_of, combine, init, e_from, e_to, me, ew,
     return acc
 
 
-def _cc_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int):
+def _cc_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
+                tile_budget: int | None = None):
     """Columnar min-label propagation — connected components for every
     (hop, window) column at once (semantics of
     ``algorithms/connected_components.py``: undirected min over both
@@ -420,7 +438,7 @@ def _cc_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int):
     I32_MAX = jnp.iinfo(jnp.int32).max
     lab0 = jnp.where(mv, jnp.arange(n_pad, dtype=jnp.int32)[:, None],
                      I32_MAX)
-    tile = _edge_tile_for(e_src.shape[0], me.shape[1])
+    tile = _edge_tile_for(e_src.shape[0], me.shape[1], tile_budget)
     max0 = jnp.full_like(lab0, I32_MAX) \
         + (mv[0] & False).astype(jnp.int32)[None, :]   # vma-seeded
 
@@ -455,26 +473,28 @@ def _cc_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int):
 
 @functools.lru_cache(maxsize=64)
 def _compiled_cc(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
-                 tdt: str):
+                 tdt: str, tile_budget: int | None = None):
     tdt = jnp.dtype(tdt)
 
     def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
             hop_of_col, T_col, w_col):
         me, mv = _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
                                hop_of_col, T_col, w_col)
-        return _cc_columns(me, mv, e_src, e_dst, n_pad, max_steps)
+        return _cc_columns(me, mv, e_src, e_dst, n_pad, max_steps,
+                           tile_budget=tile_budget)
 
     return jax.jit(run)
 
 
 def _bfs_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
-                 directed: bool, seed_mask, ew):
+                 directed: bool, seed_mask, ew,
+                 tile_budget: int | None = None):
     """Columnar min-plus traversal (``algorithms/traversal.SSSP``
     semantics); ``ew`` is 1.0 for hop counting or [m_pad, C] f32 weights.
     Shared by the single-device kernel and the column-sharded runner."""
     INF = jnp.float32(jnp.inf)
     d0 = jnp.where(mv & seed_mask[:, None], 0.0, INF)
-    tile = _edge_tile_for(e_src.shape[0], me.shape[1])
+    tile = _edge_tile_for(e_src.shape[0], me.shape[1], tile_budget)
     ew_arr = None if not hasattr(ew, "shape") or ew.ndim == 0 else ew
     inf0 = jnp.full_like(d0, INF) \
         + (mv[0] & False).astype(jnp.float32)[None, :]   # vma-seeded
@@ -512,7 +532,8 @@ def _bfs_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
 
 @functools.lru_cache(maxsize=64)
 def _compiled_bfs(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
-                  directed: bool, tdt: str, weighted: bool = False):
+                  directed: bool, tdt: str, weighted: bool = False,
+                  tile_budget: int | None = None):
     tdt = jnp.dtype(tdt)
 
     def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
@@ -521,7 +542,7 @@ def _compiled_bfs(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
                                hop_of_col, T_col, w_col)
         ew = rest[0][hop_of_col].T if weighted else 1.0   # [m_pad, C]
         return _bfs_columns(me, mv, e_src, e_dst, n_pad, max_steps,
-                            directed, seed_mask, ew)
+                            directed, seed_mask, ew, tile_budget=tile_budget)
 
     return jax.jit(run)
 
@@ -551,7 +572,7 @@ def run_bfs_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
     seed_mask = _seed_mask(tables, seed_vids)
     runner = _compiled_bfs(tables.n_pad, tables.m_pad, H, C, int(max_steps),
                            bool(directed), np.dtype(tables.tdtype).name,
-                           weight_cols is not None)
+                           weight_cols is not None, _tile_budget_bytes())
     extra = (seed_mask,) if weight_cols is None \
         else (seed_mask, weight_cols)
     return _dispatch_columns(runner, tables,
@@ -566,7 +587,7 @@ def run_cc_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
     """Columnar connected components over prebuilt per-hop fold columns."""
     H, C, hop_of_col, T_col, w_col = _column_layout(hop_times, windows)
     runner = _compiled_cc(tables.n_pad, tables.m_pad, H, C, int(max_steps),
-                          np.dtype(tables.tdtype).name)
+                          np.dtype(tables.tdtype).name, _tile_budget_bytes())
     return _dispatch_columns(runner, tables,
                              (e_lat, e_alive, v_lat, v_alive),
                              hop_of_col, T_col, w_col, e_src_dev, e_dst_dev)
@@ -576,11 +597,16 @@ class _HopBatched:
     """Shared incremental fold → per-hop state columns (deletes included).
 
     ``run(hop_times, windows, chunks=k)`` splits the sweep into ``k``
-    equal hop groups and dispatches each group as soon as its columns are
-    folded: dispatch is async, so group ``i+1``'s HOST fold overlaps group
-    ``i``'s DEVICE supersteps — the pipelining a one-dispatch sweep can't
-    have. Equal group sizes reuse one compiled program. Results are
-    identical to ``chunks=1`` (hop-major concatenation; tested)."""
+    equal hop groups and pipelines them: group ``i+1``'s HOST fold +
+    staging run in the lookahead prefetch worker (``RTPU_PREFETCH=0``
+    disables) while group ``i``'s payload ships through the pipelined
+    transfer engine and its supersteps run on DEVICE — fold → stage →
+    ship → compute, the pipelining a one-dispatch sweep can't have.
+    Equal group sizes reuse one compiled program. Results match
+    ``chunks=1`` (hop-major concatenation; tested — bitwise for the
+    integer/min-plus kernels, within solver tolerance for PageRank,
+    whose differently-shaped chunk programs may round f32 reductions
+    differently on some XLA versions)."""
 
     def __init__(self, log: EventLog):
         # fold state only — the columnar engines never emit GraphViews, so
@@ -591,8 +617,12 @@ class _HopBatched:
         # (sw.log is a fresh pin per engine and would never hit)
         self._log = log
         #: host seconds spent folding + writing columns in the LAST run()
-        #: (callers report it as snapshot-build time)
+        #: (callers report it as snapshot-build time; under the lookahead
+        #: prefetcher this is WORKER time, overlapped with device compute)
         self.fold_seconds = 0.0
+        #: seconds the LAST run()'s dispatch loop spent WAITING on the
+        #: lookahead fold — 0 means the fold hid entirely behind compute
+        self.fold_stall_seconds = 0.0
         #: host→device FOLD-STATE payload bytes of the LAST run() — the
         #: quantity the resident-base design exists to minimise. Excluded
         #: on both fold paths, so comparisons are like for like: the
@@ -693,6 +723,7 @@ class _HopBatched:
         steps when consecutive hops differ little). Warm-started results
         agree with cold ones to the solver tolerance, not bitwise."""
         self.fold_seconds = 0.0
+        self.fold_stall_seconds = 0.0
         self.ship_bytes = 0
         if warm_start and not self.supports_warm_start:
             raise ValueError(
@@ -718,6 +749,11 @@ class _HopBatched:
             self._delta_base = None
             raise
 
+    def _use_prefetch(self) -> bool:
+        import os
+
+        return os.environ.get("RTPU_PREFETCH", "1") != "0"
+
     def _run_chunks(self, hop_times, windows, chunks, warm_start,
                     hop_callback):
         if chunks == 1 or len(hop_times) % chunks:
@@ -736,14 +772,24 @@ class _HopBatched:
             return self._dispatch_cols(cols, hop_times, windows)
         per = len(hop_times) // chunks
         delta = self._use_delta_fold()
+        groups = [hop_times[c * per: (c + 1) * per] for c in range(chunks)]
+
+        def fold(group, lookahead: bool):
+            # a lookahead fold runs BEFORE the previous group's delta
+            # dispatch is issued — it must assume that dispatch will leave
+            # a device-resident base (assume_resident), or chunk 2+ would
+            # re-ship a full base snapshot the serial loop never ships
+            if delta:
+                return self._fold_deltas(group, hop_callback,
+                                         assume_resident=lookahead)
+            return self._fold_columns(group, hop_callback)
+
         outs = []
         steps = jnp.int32(0)
-        for c in range(chunks):
-            group = hop_times[c * per: (c + 1) * per]
-            if delta:
-                group, payload = self._fold_deltas(group, hop_callback)
-            else:
-                group, cols = self._fold_columns(group, hop_callback)
+
+        def dispatch(group_payload, stall):
+            group, payload = group_payload
+            self.fold_stall_seconds += stall
             r_init = None
             if warm_start and outs:
                 # previous chunk's FULL output; the kernel slices its last
@@ -755,10 +801,26 @@ class _HopBatched:
                 out, st = self._dispatch_deltas(payload, group, windows,
                                                 r_init=r_init)  # async
             else:
-                out, st = self._dispatch_cols(cols, group, windows,
+                out, st = self._dispatch_cols(payload, group, windows,
                                               r_init=r_init)   # async
             outs.append(out)
+            nonlocal steps
             steps = jnp.maximum(steps, st)
+
+        if self._use_prefetch():
+            # hop-lookahead prefetch: group c+1's host fold + staging run
+            # in the prefetch worker while group c's payload ships and its
+            # columnar program runs on device — fold → stage → ship →
+            # compute. Dispatch (result order) stays on THIS thread.
+            from ..core.sweep import prefetch_map
+
+            prefetch_map(
+                (functools.partial(fold, g, c > 0)
+                 for c, g in enumerate(groups)),
+                dispatch)
+        else:
+            for c in range(chunks):
+                dispatch(fold(groups[c], False), 0.0)
         return jnp.concatenate(outs, axis=0), steps
 
     def _fold_columns(self, hop_times, hop_callback=None):
@@ -842,7 +904,8 @@ class _HopBatched:
         bv_alive[v_idx] = v_alive
         return (epos, e_lat, e_alive), (v_idx, v_lat, v_alive)
 
-    def _fold_deltas(self, hop_times, hop_callback=None):
+    def _fold_deltas(self, hop_times, hop_callback=None,
+                     assume_resident: bool = False):
         """Delta-fold: the state at each batch's first hop (the base) plus
         per-hop touched-entity (pos, lat, alive) lists — the device
         rebuilds the hop columns (``_masks_from_deltas``). Host work and
@@ -850,7 +913,11 @@ class _HopBatched:
         that made the host fold the binding term of the headline sweep.
         The base is a RUNNING array updated by O(delta) scatters, so
         chunked (pipelined) sweeps pay the full-table materialisation
-        once, not per chunk."""
+        once, not per chunk. ``assume_resident=True`` is the lookahead
+        prefetcher's promise that the PREVIOUS group's delta dispatch will
+        have left a device-resident advanced base by the time this
+        payload dispatches (the fold runs before that dispatch is issued;
+        a dispatch failure aborts the sweep before the payload is used)."""
         f0 = _time.perf_counter()
         t = self.tables
         hop_times = [int(x) for x in hop_times]
@@ -866,7 +933,7 @@ class _HopBatched:
         ship_base = None
         # a live device-resident base makes this batch all-delta: hop 0's
         # catch-up ships in the delta[0] slot instead of a base snapshot
-        resident = (self._dev_base is not None
+        resident = ((assume_resident or self._dev_base is not None)
                     and self._delta_base is not None)
         empty = (np.empty(0, np.int32), np.empty(0, tdt),
                  np.empty(0, bool))
@@ -1104,8 +1171,10 @@ class HopBatchedSSSP(HopBatchedBFS):
                 wd.append((pos, val))
         return w_base, wd
 
-    def _fold_deltas(self, hop_times, hop_callback=None):
-        hop_times, payload = super()._fold_deltas(hop_times, hop_callback)
+    def _fold_deltas(self, hop_times, hop_callback=None,
+                     assume_resident: bool = False):
+        hop_times, payload = super()._fold_deltas(hop_times, hop_callback,
+                                                  assume_resident)
         # payload[0] is None exactly when the mask fold went all-delta
         # against the device-resident base — the weight fold must match
         return hop_times, (*payload,
@@ -1165,19 +1234,23 @@ class HopBatchedCC(_HopBatched):
 def _dispatch_columns(runner, tables, cols, hop_of_col, T_col,
                       w_col, e_src_dev, e_dst_dev, *extra):
     """Shared device dispatch for the columnar runners (`extra` appends
-    runner-specific trailing args, e.g. the BFS seed mask)."""
-    return runner(
-        e_src_dev if e_src_dev is not None else jnp.asarray(tables.e_src),
-        e_dst_dev if e_dst_dev is not None else jnp.asarray(tables.e_dst),
-        *(jnp.asarray(a) for a in cols),
-        jnp.asarray(hop_of_col), jnp.asarray(T_col), jnp.asarray(w_col),
-        *(jnp.asarray(a) for a in extra))
+    runner-specific trailing args, e.g. the BFS seed mask). The payload —
+    on the host-column path the [H, m_pad] fold columns, the largest
+    per-dispatch ship in the system — goes through the pipelined transfer
+    engine: array k+1 stages while k is on the wire, per-slice retry."""
+    from ..utils.transfer import shared_engine
+
+    return runner(*shared_engine().put_many([
+        e_src_dev if e_src_dev is not None else tables.e_src,
+        e_dst_dev if e_dst_dev is not None else tables.e_dst,
+        *cols, hop_of_col, T_col, w_col, *extra]))
 
 
 @functools.lru_cache(maxsize=16)
 def _compiled_scale(n_pad: int, m_pad: int, H: int, W: int, U_e: int,
                     U_v: int, damping: float, tol: float, max_steps: int,
-                    scan_masks: bool = False):
+                    scan_masks: bool = False,
+                    tile_budget: int | None = None):
     """Scale variant of the columnar PageRank: per-hop fold state is
     REBUILT ON DEVICE from the base state plus per-hop update lists, so a
     sweep ships O(base + deltas) bytes instead of O(m_pad * H) — at
@@ -1216,9 +1289,30 @@ def _compiled_scale(n_pad: int, m_pad: int, H: int, W: int, U_e: int,
         me = hop_masks(base_e, de_pos, de_t)
         mv = hop_masks(base_v, dv_pos, dv_t)
         return _pagerank_columns(me, mv, e_src, e_dst, n_pad,
-                                 damping, tol, max_steps)
+                                 damping, tol, max_steps,
+                                 tile_budget=tile_budget)
 
     return jax.jit(run)
+
+
+def _delta_fingerprint(deltas_e, deltas_v) -> tuple:
+    """Cheap identity of the delta lists a scale payload was built from:
+    per-hop lengths plus an xor checksum over BOTH the pos and time
+    arrays (same positions with different update times are different
+    deltas). O(Σ delta) memory-bandwidth work — a payload built from
+    DIFFERENT deltas must fail loudly in ``run_scale_columns`` instead of
+    returning mislabelled results."""
+    def xor(a):
+        a = np.asarray(a)
+        if not len(a):
+            return 0
+        return int(np.bitwise_xor.reduce(a.astype(np.int64, copy=False)))
+
+    def fp(deltas):
+        return tuple((int(len(p)), xor(p) ^ (xor(t) << 1))
+                     for p, t in deltas)
+
+    return fp(deltas_e), fp(deltas_v)
 
 
 def prepare_scale_payload(deltas_e, deltas_v, hop_times, windows):
@@ -1256,9 +1350,11 @@ def prepare_scale_payload(deltas_e, deltas_v, hop_times, windows):
     U_e, U_v = pad_for(deltas_e), pad_for(deltas_v)
     de_pos, de_t = pad_deltas(deltas_e, U_e)
     dv_pos, dv_t = pad_deltas(deltas_v, U_v)
-    # (hop_times, windows) fingerprint: a payload prepared for one sweep
-    # grid must not silently relabel another same-shape sweep's results
-    fp = (tuple(int(x) for x in hop_times), tuple(wlist))
+    # fingerprint: (hop_times, windows) grid AND the delta lists (per-hop
+    # lengths + pos checksums) — a payload prepared for one sweep must not
+    # silently relabel another same-shape sweep's results
+    fp = (tuple(int(x) for x in hop_times), tuple(wlist),
+          _delta_fingerprint(deltas_e, deltas_v))
     return (U_e, U_v, device_put_chunked(de_pos), device_put_chunked(de_t),
             device_put_chunked(dv_pos), device_put_chunked(dv_t),
             jnp.asarray(thr), fp)
@@ -1281,20 +1377,31 @@ def run_scale_columns(bulk, base_e, base_v, deltas_e, deltas_v, hop_times,
     if prepared is None:
         prepared = prepare_scale_payload(deltas_e, deltas_v, hop_times,
                                          windows)
-    U_e, U_v, de_pos, de_t, dv_pos, dv_t, thr, fp = prepared
-    want = (tuple(int(x) for x in hop_times), tuple(wlist))
-    if fp != want:
-        raise ValueError(
-            "prepared payload was built for a different sweep grid "
-            f"(prepared {fp[0][:2]}.../{fp[1]}, called with "
-            f"{want[0][:2]}.../{want[1]}) — prepare_scale_payload must "
-            "see the SAME hop_times/windows (and the same deltas)")
+        U_e, U_v, de_pos, de_t, dv_pos, dv_t, thr, fp = prepared
+    else:
+        # caller-supplied payload: verify it was built from THESE deltas
+        # and THIS grid (the fresh-built branch above trivially was —
+        # don't re-walk O(Σ delta) bytes to prove it)
+        U_e, U_v, de_pos, de_t, dv_pos, dv_t, thr, fp = prepared
+        want = (tuple(int(x) for x in hop_times), tuple(wlist),
+                _delta_fingerprint(deltas_e, deltas_v))
+        if fp[:2] != want[:2]:
+            raise ValueError(
+                "prepared payload was built for a different sweep grid "
+                f"(prepared {fp[0][:2]}.../{fp[1]}, called with "
+                f"{want[0][:2]}.../{want[1]}) — prepare_scale_payload must "
+                "see the SAME hop_times/windows (and the same deltas)")
+        if len(fp) > 2 and fp[2] != want[2]:
+            raise ValueError(
+                "prepared payload was built from DIFFERENT delta lists "
+                "(per-hop length/checksum mismatch) — results would be "
+                "mislabelled; re-run prepare_scale_payload on these deltas")
     import os
 
     scan_masks = os.environ.get("RTPU_SCALE_MASKS", "unroll") == "scan"
     runner = _compiled_scale(bulk.n_pad, bulk.m_pad, H, W, U_e, U_v,
                              float(damping), float(tol), int(max_steps),
-                             scan_masks)
+                             scan_masks, _tile_budget_bytes())
     return runner(
         e_src_dev if e_src_dev is not None else jnp.asarray(bulk.e_src),
         e_dst_dev if e_dst_dev is not None else jnp.asarray(bulk.e_dst),
@@ -1327,7 +1434,8 @@ def run_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times, windows,
     H, C, hop_of_col, T_col, w_col = _column_layout(hop_times, windows)
     runner = _compiled(tables.n_pad, tables.m_pad, H, C, float(damping),
                        float(tol), int(max_steps),
-                       np.dtype(tables.tdtype).name, r_init is not None)
+                       np.dtype(tables.tdtype).name, r_init is not None,
+                       _tile_budget_bytes())
     extra = () if r_init is None else (r_init,)
     return _dispatch_columns(runner, tables,
                              (e_lat, e_alive, v_lat, v_alive),

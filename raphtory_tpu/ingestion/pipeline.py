@@ -161,9 +161,12 @@ class IngestionPipeline:
                 # events) — matching direct mode, where the exception kills
                 # the consume loop. The "finish" marker still releases the
                 # fence, exactly like _consume's finally.
-                self._failed.add(name)
+                # record the ROOT cause BEFORE raising the poison flag: a
+                # source seeing _failed re-raises a generic RuntimeError,
+                # and its setdefault must lose to this one, not win a race
                 self.errors.setdefault(name, (
                     f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+                self._failed.add(name)
 
     def _sink_batch(self, name: str, t, k, s, d, props=None,
                     wm: int | None = None) -> None:

@@ -83,6 +83,29 @@ class Metrics:
         self.supersteps = Counter(
             "raphtory_supersteps_total",
             "BSP supersteps executed on device", registry=r)
+        # transfer pipeline (utils/transfer.TransferEngine) — the H2D link
+        # is the term that bounds a real sweep on a tunnelled accelerator,
+        # so the pipeline's stalls are first-class signals
+        self.h2d_bytes = Counter(
+            "raphtory_h2d_bytes_total",
+            "Host→device bytes shipped through the transfer engine",
+            registry=r)
+        self.h2d_slices = Counter(
+            "raphtory_h2d_slices_total",
+            "Chunked upload slices issued", registry=r)
+        self.h2d_retries = Counter(
+            "raphtory_h2d_retries_total",
+            "Per-slice transport retries (UNAVAILABLE-class errors)",
+            registry=r)
+        self.h2d_stall_seconds = Counter(
+            "raphtory_h2d_stall_seconds_total",
+            "Seconds a transfer-pipeline stage spent stalled (stage=host "
+            "staging copy, wire=blocked on an in-flight put, fold=sweep "
+            "waiting on the hop-lookahead host fold)", ["stage"],
+            registry=r)
+        self.h2d_inflight_depth = Gauge(
+            "raphtory_h2d_inflight_depth",
+            "High-water in-flight device_put window depth", registry=r)
         # memory governor (Archivist signals)
         self.compactions = Counter(
             "raphtory_compactions_total",

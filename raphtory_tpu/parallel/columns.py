@@ -85,12 +85,13 @@ def run_columns_sharded(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
             raise ValueError(f"unknown columnar kind {kind!r}")
         return out, steps[None]   # scalar -> [1] so steps concatenates
 
-    shard = jax.jit(jax.shard_map(
+    from .sharded import _shard_map
+
+    shard = jax.jit(_shard_map(
         block, mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P(),   # tables replicate
                   P(C_AXIS), P(C_AXIS), P(C_AXIS), *extra_specs),
-        out_specs=(P(C_AXIS), P(C_AXIS)),
-        check_vma=True))
+        out_specs=(P(C_AXIS), P(C_AXIS))))
 
     repl = NamedSharding(mesh, P())
     put = lambda a: jax.device_put(jnp.asarray(a), repl)

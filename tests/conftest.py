@@ -17,6 +17,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests may spawn
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.5): the option doesn't exist, but the XLA flag does —
+    # backends are lazy, so the env var is still consumed at first use
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -29,7 +29,11 @@ def test_column_sharded_matches_single_device(n_dev, windows):
     many, steps2 = run_columns_sharded(
         hb.tables, *cols, hops, windows, jax.devices()[:n_dev],
         tol=1e-7, max_steps=20)
-    np.testing.assert_array_equal(np.asarray(one), np.asarray(many))
+    # tight-tolerance, not bitwise: the column-sharded program partitions
+    # the f32 segment sums differently from the single-device one, and
+    # some XLA versions round the fused reductions differently (~1e-8)
+    np.testing.assert_allclose(np.asarray(one), np.asarray(many),
+                               rtol=1e-5, atol=1e-7)
     assert int(steps1) == steps2
 
 

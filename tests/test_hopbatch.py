@@ -125,9 +125,13 @@ def test_hopbatch_bfs_matches_per_view(directed):
 
 @pytest.mark.parametrize("chunks", [2, 3, 6])
 def test_hopbatch_chunked_matches_one_dispatch(chunks):
-    """The pipelined chunked sweep must be bit-identical to chunks=1 for
-    all three engines (hop-major concatenation over 6 hops, so every
-    parametrized chunk count genuinely splits the sweep)."""
+    """The pipelined chunked sweep must match chunks=1 for all three
+    engines (hop-major concatenation over 6 hops, so every parametrized
+    chunk count genuinely splits the sweep). PageRank compares at a hair
+    under the solver tolerance, not bitwise: the chunked sweep compiles an
+    H=len/chunks program whose segment-sum fusion can round differently
+    from the H=6 one on some XLA versions (~1e-8 observed on XLA 0.4
+    CPU). CC/BFS are integer/min-plus — exact on every backend."""
     from raphtory_tpu.engine.hopbatch import HopBatchedBFS, HopBatchedCC
 
     rng = np.random.default_rng(11)
@@ -138,7 +142,7 @@ def test_hopbatch_chunked_matches_one_dispatch(chunks):
         HopBatchedPageRank(log, tol=1e-7, max_steps=20).run(hops, windows)[0])
     many = np.asarray(HopBatchedPageRank(log, tol=1e-7, max_steps=20)
                       .run(hops, windows, chunks=chunks)[0])
-    np.testing.assert_array_equal(one, many)
+    np.testing.assert_allclose(one, many, rtol=1e-5, atol=1e-7)
 
     one_cc = np.asarray(HopBatchedCC(log, max_steps=60).run(hops, windows)[0])
     many_cc = np.asarray(HopBatchedCC(log, max_steps=60)
