@@ -16,6 +16,7 @@ import time as _time
 
 from ..ingestion.watermark import WatermarkRegistry
 from ..obs.metrics import METRICS
+from ..obs.trace import TRACER
 from .events import EventLog
 from .snapshot import GraphView, build_view
 
@@ -81,8 +82,10 @@ class TemporalGraph:
                 self._cache.move_to_end(key)
                 return hit
         t0 = _time.perf_counter()
-        view = build_view(self.log, int(time),
-                          include_occurrences=include_occurrences)
+        with TRACER.span("snapshot.fold", time=int(time),
+                         occurrences=bool(include_occurrences)):
+            view = build_view(self.log, int(time),
+                              include_occurrences=include_occurrences)
         METRICS.snapshot_build_seconds.observe(_time.perf_counter() - t0)
         self.cache_put(int(time), view, include_occurrences, version=version)
         return view

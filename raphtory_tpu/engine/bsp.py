@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.snapshot import GraphView
+from ..obs.trace import TRACER, block_steps
 from ..ops.segment import segment_combine, segment_sum_sorted_csr
 from .program import Context, Edges, VertexProgram
 
@@ -300,19 +301,22 @@ def run_async(
     win_arr = jnp.asarray([(-1 if w is None else int(w)) for w in wlist], jnp.int64)
 
     dummy64 = jnp.zeros((1,), jnp.int64)
-    result, steps = runner(
-        jnp.asarray(np.packbits(v_masks, axis=1, bitorder="little")),
-        jnp.asarray(np.packbits(e_masks, axis=1, bitorder="little")),
-        jnp.asarray(view.vids) if program.needs_vids else dummy64,
-        (jnp.asarray(view.v_latest_time)
-         if program.needs_vertex_times else dummy64),
-        (jnp.asarray(view.v_first_time)
-         if program.needs_vertex_times else dummy64),
-        jnp.asarray(e_src), jnp.asarray(e_dst),
-        jnp.asarray(e_latest) if program.needs_edge_times else dummy64,
-        jnp.asarray(e_first) if program.needs_edge_times else dummy64,
-        jnp.asarray(view.time, jnp.int64), win_arr, eprops, vprops,
-    )
+    with TRACER.span("bsp.dispatch", n=int(view.n_pad), m=int(m_pad),
+                        windows=k, time=int(view.time),
+                        program=type(program).__name__):
+        result, steps = runner(
+            jnp.asarray(np.packbits(v_masks, axis=1, bitorder="little")),
+            jnp.asarray(np.packbits(e_masks, axis=1, bitorder="little")),
+            jnp.asarray(view.vids) if program.needs_vids else dummy64,
+            (jnp.asarray(view.v_latest_time)
+             if program.needs_vertex_times else dummy64),
+            (jnp.asarray(view.v_first_time)
+             if program.needs_vertex_times else dummy64),
+            jnp.asarray(e_src), jnp.asarray(e_dst),
+            jnp.asarray(e_latest) if program.needs_edge_times else dummy64,
+            jnp.asarray(e_first) if program.needs_edge_times else dummy64,
+            jnp.asarray(view.time, jnp.int64), win_arr, eprops, vprops,
+        )
     if not batched:
         result = jax.tree_util.tree_map(lambda a: a[0], result)
     return result, steps
@@ -328,4 +332,5 @@ def run(
     """Blocking ``run_async``: waits for the device and returns
     (result, int steps)."""
     result, steps = run_async(program, view, window=window, windows=windows)
-    return result, int(steps)
+    _, steps = block_steps(lambda: (None, steps))
+    return result, steps

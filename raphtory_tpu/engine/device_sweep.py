@@ -47,8 +47,51 @@ from ..core.events import EDGE_ADD, EDGE_DELETE, EventLog
 from ..core.snapshot import INT64_MIN, _pad_bucket
 from ..core.sweep import _ENC_MASK, _ENC_SHIFT, SweepBuilder
 from ..native import lib as _native
+from ..obs.trace import TRACER
+from ..utils.transfer import _metrics
 from .bsp import make_mask_runner
 from .program import VertexProgram
+
+
+def sweep_phase_summary(sp, elapsed, fold_seconds, fold_stall_seconds,
+                        ship_delta, ship_bytes, n_hops):
+    """Per-sweep fold/stage/ship/compute phase breakdown, attached to the
+    sweep span AND observed into ``raphtory_sweep_phase_seconds{phase}``
+    — shared by both sweep engines. ``fold`` is host fold+staging time
+    (worker-thread time under the lookahead prefetcher), ``stage``/
+    ``ship`` are the transfer engine's staging-copy and wire-wait stalls
+    accumulated during THIS sweep (``TransferStats.delta_since``), and
+    ``compute`` is the dispatch-loop wall residual (device compute plus
+    Python driving) — elapsed minus the fold stall and transfer stalls
+    the loop actually waited on. Per-hop numbers are these divided by
+    ``n_hops``. Returns the phase dict (engines keep it as
+    ``last_phase_seconds``).
+
+    Attribution caveat: the stage/ship deltas come from the PROCESS-WIDE
+    shared transfer engine, so when several jobs sweep concurrently each
+    summary includes the others' H2D stalls (and compute, the residual,
+    shrinks correspondingly). Serial operation — the bench protocol and
+    the common single-job server — attributes exactly; for contended
+    timelines read the per-slice ``ship.*`` spans, which carry their own
+    thread/track, instead of the summary."""
+    stage = float(ship_delta.get("stage_stall_seconds", 0.0))
+    wire = float(ship_delta.get("wire_stall_seconds", 0.0))
+    phases = {
+        "fold": float(fold_seconds),
+        "stage": stage,
+        "ship": wire,
+        "compute": max(float(elapsed) - float(fold_stall_seconds)
+                       - stage - wire, 0.0),
+    }
+    m = _metrics()
+    if m is not None:
+        for ph, sec in phases.items():
+            m.sweep_phase_seconds.labels(ph).observe(sec)
+    sp.set(elapsed_seconds=round(float(elapsed), 6),
+           fold_stall_seconds=round(float(fold_stall_seconds), 6),
+           ship_bytes=int(ship_bytes), n_hops=int(n_hops),
+           **{f"{ph}_seconds": round(sec, 6) for ph, sec in phases.items()})
+    return phases
 
 
 def supported(program: VertexProgram) -> bool:
@@ -288,6 +331,9 @@ class DeviceSweep:
         #: run_sweep only: seconds the dispatch loop spent WAITING on the
         #: lookahead fold — 0 means the fold fully hid behind device compute
         self.fold_stall_seconds = 0.0
+        #: the LAST run_sweep's fold/stage/ship/compute breakdown
+        #: (``sweep_phase_summary``) — the per-sweep phase summary
+        self.last_phase_seconds: dict = {}
         # a failure between fold and device apply leaves t_now ahead of
         # _bufs (the lookahead fold may even have advanced PAST the failed
         # hop) — the next fold must take the full-refresh path, never the
@@ -308,6 +354,13 @@ class DeviceSweep:
         hop's scatter + superstep run on device. The returned payload
         carries its own hop time (``self.t_now`` keeps moving under a
         lookahead fold)."""
+        with TRACER.span("hop.fold", time=int(time),
+                            engine="device_sweep") as sp:
+            payload = self._fold_hop_inner(time)
+            sp.set(kind=payload["kind"])
+        return payload
+
+    def _fold_hop_inner(self, time: int) -> dict:
         f0 = _time.perf_counter()
         time = int(time)
         if self.t_now is not None and time < self.t_now:
@@ -369,6 +422,12 @@ class DeviceSweep:
         kind = payload["kind"]
         if kind == "noop":
             return
+        with TRACER.span("hop.ship", kind=kind,
+                            time=int(payload["time"])):
+            self._apply_staged_inner(payload)
+
+    def _apply_staged_inner(self, payload: dict) -> None:
+        kind = payload["kind"]
         from ..utils.transfer import shared_engine
 
         try:
@@ -479,10 +538,12 @@ class DeviceSweep:
 
         runner = _compiled_run(program, self.n_pad, self.m_pad, len(wlist),
                                np.dtype(self.tdtype).name)
-        result, steps = runner(
-            *self._bufs, self.vids, self.e_src, self.e_dst,
-            jnp.asarray(int(T), jnp.int64),
-            jnp.asarray(wlist, jnp.int64))
+        with TRACER.span("hop.compute", time=int(T), windows=len(wlist),
+                            engine="device_sweep"):
+            result, steps = runner(
+                *self._bufs, self.vids, self.e_src, self.e_dst,
+                jnp.asarray(int(T), jnp.int64),
+                jnp.asarray(wlist, jnp.int64))
         if not batched:
             result = jax.tree_util.tree_map(lambda a: a[0], result)
         return result, steps
@@ -519,6 +580,23 @@ class DeviceSweep:
         self.fold_seconds = 0.0
         self.fold_stall_seconds = 0.0
         self.ship_bytes = 0
+        from ..utils.transfer import shared_engine
+
+        before = shared_engine().stats.as_dict()
+        t_start = _time.perf_counter()
+        with TRACER.span("sweep.range", engine="device_sweep",
+                            hops=len(times),
+                            program=type(program).__name__) as sp:
+            out = self._run_sweep_impl(program, times, window, windows,
+                                       prefetch)
+            self.last_phase_seconds = sweep_phase_summary(
+                sp, _time.perf_counter() - t_start, self.fold_seconds,
+                self.fold_stall_seconds,
+                shared_engine().stats.delta_since(before),
+                self.ship_bytes, len(times))
+        return out
+
+    def _run_sweep_impl(self, program, times, window, windows, prefetch):
         results, steps = [], []
         if not prefetch or len(times) <= 1:
             for T in times:
@@ -530,10 +608,12 @@ class DeviceSweep:
         import functools as _ft
 
         from ..core.sweep import prefetch_map
-        from ..utils.transfer import _metrics
 
         def step(payload, stall):
             self.fold_stall_seconds += stall
+            if stall > 0:
+                TRACER.complete("fold.stall", stall,
+                                   time=int(payload["time"]))
             m = _metrics()
             if m is not None:
                 m.h2d_stall_seconds.labels(stage="fold").inc(stall)

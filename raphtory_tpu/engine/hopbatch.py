@@ -36,7 +36,9 @@ import logging
 
 from ..core.events import EventLog
 from ..core.sweep import SweepBuilder
-from .device_sweep import GlobalTables, _device_edges, normalize_windows
+from ..obs.trace import TRACER
+from .device_sweep import (GlobalTables, _device_edges, normalize_windows,
+                           sweep_phase_summary)
 
 _log = logging.getLogger(__name__)
 
@@ -386,12 +388,14 @@ def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
     # errors (device-resident inputs pass through untouched)
     from ..utils.transfer import shared_engine
 
-    return runner(*shared_engine().put_many([
-        e_src_dev if e_src_dev is not None else tables.e_src,
-        e_dst_dev if e_dst_dev is not None else tables.e_dst,
-        be_lat, be_alive, bv_lat, bv_alive,
-        de_pos, de_lat, de_alive, dv_pos, dv_lat, dv_alive,
-        T_col, w_col, *extra]))
+    with TRACER.span("hop.compute", kind=kind, hops=H, cols=H * W,
+                        resident_base=h0_delta):
+        return runner(*shared_engine().put_many([
+            e_src_dev if e_src_dev is not None else tables.e_src,
+            e_dst_dev if e_dst_dev is not None else tables.e_dst,
+            be_lat, be_alive, bv_lat, bv_alive,
+            de_pos, de_lat, de_alive, dv_pos, dv_lat, dv_alive,
+            T_col, w_col, *extra]))
 
 
 def _edge_accumulate(seg, payload_of, combine, init, e_from, e_to, me, ew,
@@ -629,6 +633,9 @@ class _HopBatched:
         #: per-log static tables (ship once per log), O(C) column
         #: descriptors, and per-dispatch seed masks.
         self.ship_bytes = 0
+        #: the LAST run()'s fold/stage/ship/compute breakdown
+        #: (``device_sweep.sweep_phase_summary``)
+        self.last_phase_seconds: dict = {}
         # static edge tables upload LAZILY on the first dispatch (callers
         # that only use the host fold — e.g. the column-sharded mesh
         # route — never pay the device transfer), then cache
@@ -732,9 +739,22 @@ class _HopBatched:
                 "just slower)")
         hop_times = [int(x) for x in hop_times]
         chunks = max(1, min(int(chunks), len(hop_times)))
+        from ..utils.transfer import shared_engine
+
+        before = shared_engine().stats.as_dict()
+        t_start = _time.perf_counter()
         try:
-            return self._run_chunks(hop_times, windows, chunks, warm_start,
-                                    hop_callback)
+            with TRACER.span("sweep.columnar",
+                                engine=type(self).__name__,
+                                hops=len(hop_times), chunks=chunks) as sp:
+                out = self._run_chunks(hop_times, windows, chunks,
+                                       warm_start, hop_callback)
+                self.last_phase_seconds = sweep_phase_summary(
+                    sp, _time.perf_counter() - t_start, self.fold_seconds,
+                    self.fold_stall_seconds,
+                    shared_engine().stats.delta_since(before),
+                    self.ship_bytes, len(hop_times))
+            return out
         except Exception:
             # ANY mid-run failure (fold, hop_callback, dispatch) may leave
             # the host fold ahead of the device-resident base — drop
@@ -764,12 +784,18 @@ class _HopBatched:
                     "%d hops do not split into %d equal chunks — running "
                     "one cold dispatch (warm_start has no effect)",
                     len(hop_times), chunks)
-            if self._use_delta_fold():
-                hop_times, payload = self._fold_deltas(hop_times,
-                                                       hop_callback)
+            delta = self._use_delta_fold()
+            with TRACER.span("hop.fold", hops=len(hop_times),
+                                engine=type(self).__name__):
+                if delta:
+                    hop_times, payload = self._fold_deltas(hop_times,
+                                                           hop_callback)
+                else:
+                    hop_times, payload = self._fold_columns(hop_times,
+                                                            hop_callback)
+            if delta:
                 return self._dispatch_deltas(payload, hop_times, windows)
-            hop_times, cols = self._fold_columns(hop_times, hop_callback)
-            return self._dispatch_cols(cols, hop_times, windows)
+            return self._dispatch_cols(payload, hop_times, windows)
         per = len(hop_times) // chunks
         delta = self._use_delta_fold()
         groups = [hop_times[c * per: (c + 1) * per] for c in range(chunks)]
@@ -779,10 +805,12 @@ class _HopBatched:
             # dispatch is issued — it must assume that dispatch will leave
             # a device-resident base (assume_resident), or chunk 2+ would
             # re-ship a full base snapshot the serial loop never ships
-            if delta:
-                return self._fold_deltas(group, hop_callback,
-                                         assume_resident=lookahead)
-            return self._fold_columns(group, hop_callback)
+            with TRACER.span("hop.fold", hops=len(group),
+                                engine=type(self).__name__):
+                if delta:
+                    return self._fold_deltas(group, hop_callback,
+                                             assume_resident=lookahead)
+                return self._fold_columns(group, hop_callback)
 
         outs = []
         steps = jnp.int32(0)
@@ -790,6 +818,8 @@ class _HopBatched:
         def dispatch(group_payload, stall):
             group, payload = group_payload
             self.fold_stall_seconds += stall
+            if stall > 0:
+                TRACER.complete("fold.stall", stall, hops=len(group))
             r_init = None
             if warm_start and outs:
                 # previous chunk's FULL output; the kernel slices its last
@@ -1240,10 +1270,11 @@ def _dispatch_columns(runner, tables, cols, hop_of_col, T_col,
     engine: array k+1 stages while k is on the wire, per-slice retry."""
     from ..utils.transfer import shared_engine
 
-    return runner(*shared_engine().put_many([
-        e_src_dev if e_src_dev is not None else tables.e_src,
-        e_dst_dev if e_dst_dev is not None else tables.e_dst,
-        *cols, hop_of_col, T_col, w_col, *extra]))
+    with TRACER.span("hop.compute", cols=int(len(T_col))):
+        return runner(*shared_engine().put_many([
+            e_src_dev if e_src_dev is not None else tables.e_src,
+            e_dst_dev if e_dst_dev is not None else tables.e_dst,
+            *cols, hop_of_col, T_col, w_col, *extra]))
 
 
 @functools.lru_cache(maxsize=16)

@@ -18,6 +18,7 @@ import numpy as np
 from ..core import events as ev
 from ..core.events import EventLog
 from ..obs.metrics import METRICS
+from ..obs.trace import TRACER
 from .parser import IdentityParser, Parser
 from .source import Source
 from .updates import EdgeAdd, EdgeDelete, VertexAdd, VertexDelete, assign_id
@@ -147,7 +148,9 @@ class IngestionPipeline:
                         continue   # poisoned: no appends, no wm advance
                     t, k, s, d, props = payload
                     if len(t):
-                        self.log.append_batch(t, k, s, d, props=props)
+                        with TRACER.span("ingest.append", source=name,
+                                         events=int(len(t)), stage="writer"):
+                            self.log.append_batch(t, k, s, d, props=props)
                         METRICS.log_events.set(self.log.n)
                     if wm is not None:
                         self.watermarks.advance(name, wm)
@@ -175,7 +178,9 @@ class IngestionPipeline:
         batch so safe_time never overtakes events still in the queue."""
         if not self.staged:
             if len(t):
-                self.log.append_batch(t, k, s, d, props=props)
+                with TRACER.span("ingest.append", source=name,
+                                 events=int(len(t)), stage="direct"):
+                    self.log.append_batch(t, k, s, d, props=props)
                 METRICS.log_events.set(self.log.n)
             if wm is not None:
                 self.watermarks.advance(name, wm)
@@ -215,7 +220,8 @@ class IngestionPipeline:
 
     def _consume(self, source: Source, parser: Parser) -> None:
         try:
-            self._consume_inner(source, parser)
+            with TRACER.span("ingest.source", source=source.name):
+                self._consume_inner(source, parser)
         except Exception as e:  # noqa: BLE001 — surfaced via self.errors
             import traceback
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 import threading
 
 from ..obs.metrics import METRICS
+from ..obs.trace import TRACER
 
 _NEG_INF = -(2**62)
 
@@ -33,12 +34,17 @@ class WatermarkRegistry:
             self._marks.setdefault(source, _NEG_INF)
 
     def advance(self, source: str, watermark: int) -> None:
+        advanced = False
         with self._lock:
             cur = self._marks.get(source, _NEG_INF)
             if watermark > cur:
                 self._marks[source] = watermark
+                advanced = True
             self._gauge_locked()
             self._cond.notify_all()
+        if advanced and TRACER.enabled:   # instant marker, outside the lock
+            TRACER.instant("watermark.advance", source=source,
+                           watermark=int(watermark))
 
     def finish(self, source: str) -> None:
         """Source exhausted: it can never hold the fence back again."""
@@ -46,6 +52,8 @@ class WatermarkRegistry:
             self._done.add(source)
             self._gauge_locked()
             self._cond.notify_all()
+        if TRACER.enabled:
+            TRACER.instant("watermark.finish", source=source)
 
     def wait_for(self, time: int, timeout: float | None = None) -> bool:
         """Block until ``safe_time() >= time`` (True) or timeout (False) —

@@ -21,6 +21,7 @@ from ..core.service import StaleViewError, TemporalGraph
 from ..engine import bsp
 from ..engine.program import VertexProgram
 from ..obs.metrics import METRICS
+from ..obs.trace import TRACER, block_steps as _block_steps
 
 import logging
 
@@ -105,6 +106,13 @@ class Job:
 
     def _run(self) -> None:
         METRICS.jobs_started.labels(type(self.query).__name__).inc()
+        with TRACER.span("job", job_id=self.id,
+                         kind=type(self.query).__name__,
+                         program=type(self.program).__name__) as jsp:
+            self._run_query()
+            jsp.set(status=self.status)
+
+    def _run_query(self) -> None:
         try:
             q = self.query
             if isinstance(q, ViewQuery):
@@ -318,7 +326,8 @@ class Job:
                                   warm_start=chunks > 1
                                   and hb.supports_warm_start,
                                   hop_callback=grab_shell)
-            ranks = np.asarray(ranks)
+            ranks, steps = _block_steps(
+                lambda: (np.asarray(ranks), steps))
         except Exception as e:
             # a device failure mid-dispatch falls back to the
             # O(1)-memory-per-hop device-resident route (which rebuilds
@@ -394,7 +403,8 @@ class Job:
             ranks, steps = run_columns_sharded(
                 hb.tables, *cols, hops, windows,
                 self.mesh.devices.ravel(), **kw)
-            ranks = np.asarray(ranks)
+            ranks, steps = _block_steps(
+                lambda: (np.asarray(ranks), steps))
         except Exception as e:
             # replicating the tables can exhaust one chip's HBM on graphs
             # the host-side guard admits — fall through to the
@@ -461,7 +471,7 @@ class Job:
         # now (the pipelined hop's fold) so _emit's end-to-end clock reads
         # dispatch-window + blocking tail only.
         t0 = t0 + (_time.perf_counter() - t_disp)
-        steps = int(steps)
+        _, steps = _block_steps(lambda: (None, steps))
         METRICS.supersteps.inc(max(steps, 0))
         if q.windows is not None:
             for i, w in enumerate(q.windows):
@@ -506,8 +516,8 @@ class Job:
             windows = list(q.windows) if q.windows is not None else None
             result, steps = sweep.run(p, window=q.window, windows=windows)
             rv = _DeviceShell(sweep).freeze()
-            result = jax.tree_util.tree_map(np.asarray, result)  # block here
-            steps = int(steps)
+            result, steps = _block_steps(lambda: (
+                jax.tree_util.tree_map(np.asarray, result), steps))
         except Exception as e:
             # device trouble mid-dispatch: a partially applied delta (or a
             # failed donated-buffer call) can leave the device state

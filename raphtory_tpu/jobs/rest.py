@@ -7,6 +7,13 @@ Same five endpoints here on a stdlib ThreadingHTTPServer (no web-framework
 dependency). Request bodies take the reference's field names
 (analyserName, timestamp, start/end/jump, windowType, windowSize, windowSet,
 repeatTime, rawFile) with `params` as an extension for hyperparameters.
+
+Operational extensions (no reference analogue — SURVEY §5.1 "No spans"):
+GET ``/healthz`` (liveness), ``/statusz`` (job table, watermarks, transfer
+stats, compile-cache sizes, flight-recorder state) and ``/tracez`` (recent
+spans; ``?n=``, ``?format=chrome`` for a full Chrome trace-event document,
+``?dump=1`` to write it to a server-side temp file, ``?enable=0|1`` to
+toggle tracing at runtime).
 """
 
 from __future__ import annotations
@@ -16,10 +23,60 @@ import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..obs.trace import TRACER
 from . import registry
 from .manager import AnalysisManager, LiveQuery, RangeQuery, ViewQuery
 
 DEFAULT_PORT = 8081
+
+
+def _compile_cache_sizes() -> dict:
+    """currsize/hits/misses of every lru_cached compiled-program factory —
+    the \"how many XLA programs is this process holding\" signal that made
+    the PREWARM sizing note in docs/OPERATIONS.md guesswork until now."""
+    out = {}
+    from ..engine import bsp as _bsp
+    from ..engine import device_sweep as _ds
+    from ..engine import hopbatch as _hb
+
+    for mod, names in ((_bsp, ("_compiled_runner",)),
+                       (_ds, ("_compiled_run", "_compiled_apply")),
+                       (_hb, ("_compiled", "_compiled_delta", "_compiled_cc",
+                              "_compiled_bfs", "_compiled_scale"))):
+        short = mod.__name__.rsplit(".", 1)[-1]
+        for nm in names:
+            fn = getattr(mod, nm, None)
+            info = getattr(fn, "cache_info", None)
+            if info is None:
+                continue
+            ci = info()
+            out[f"{short}.{nm}"] = {"size": ci.currsize, "hits": ci.hits,
+                                    "misses": ci.misses}
+    return out
+
+
+def _statusz(manager: AnalysisManager) -> dict:
+    from ..utils.transfer import shared_engine
+
+    g = manager.graph
+    eng = shared_engine()
+    status: dict = {
+        "jobs": manager.jobs(),
+        "log_events": int(g.log.n),
+        "watermark": {
+            "safe_time": int(g.safe_time()),
+            "sources": {k: int(v)
+                        for k, v in g.watermarks.snapshot().items()},
+        },
+        "transfer": {"depth": eng.depth, **eng.stats.as_dict()},
+        "compile_caches": _compile_cache_sizes(),
+        "trace": TRACER.status(),
+    }
+    try:
+        status["latest_time"] = int(g.latest_time)
+    except Exception:   # empty log has no latest time
+        status["latest_time"] = None
+    return status
 
 
 def _windows_from(body: dict):
@@ -95,6 +152,24 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
+    def _tracez(self, qs: dict) -> None:
+        """Flight-recorder surface: recent spans as JSON. ``enable=0|1``
+        toggles tracing; ``dump=1`` writes the full Chrome trace to a
+        server-chosen temp file (never a caller-supplied path — the REST
+        surface must not become a file-write primitive)."""
+        if "enable" in qs:
+            (TRACER.enable if qs["enable"][0] not in ("0", "false")
+             else TRACER.disable)()
+        payload: dict = dict(TRACER.status())
+        if qs.get("dump", ["0"])[0] not in ("0", "false"):
+            payload["dumped"] = TRACER.dump()
+        if qs.get("format", [""])[0] == "chrome":
+            payload["trace"] = TRACER.chrome_trace()
+        else:
+            n = int(qs.get("n", ["200"])[0])
+            payload["spans"] = TRACER.recent(n)
+        self._json(200, payload)
+
     def do_GET(self):
         try:
             parsed = urllib.parse.urlparse(self.path)
@@ -114,6 +189,12 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(200, self.manager.jobs())
             if path == "/Analysers":
                 return self._json(200, registry.names())
+            if path == "/healthz":
+                return self._json(200, {"status": "ok"})
+            if path == "/statusz":
+                return self._json(200, _statusz(self.manager))
+            if path == "/tracez":
+                return self._tracez(qs)
             return self._json(404, {"error": f"unknown path {self.path}"})
         except KeyError as e:
             self._json(404, {"error": f"KeyError: {e}"})

@@ -6,7 +6,17 @@ set is prometheus_client metrics updated by the pipeline/job/compaction
 layers, plus a JAX profiler hook for device traces (the capability Kamon's
 AspectJ weaver has no analogue for)."""
 
-from .metrics import METRICS, MetricsServer, Metrics
-from .profile import device_trace, annotate
+from .trace import TRACER, Tracer, span   # stdlib-only — always available
 
-__all__ = ["METRICS", "Metrics", "MetricsServer", "device_trace", "annotate"]
+try:
+    # metrics + device profiling need prometheus_client / jax, which
+    # stripped transport-only environments may lack; the span tracer must
+    # keep working there (utils/transfer.py relies on this degradation)
+    from .metrics import METRICS, Metrics, MetricsServer
+    from .profile import annotate, device_trace
+except ImportError:   # pragma: no cover — stripped environment
+    METRICS = Metrics = MetricsServer = None
+    device_trace = annotate = None
+
+__all__ = ["METRICS", "Metrics", "MetricsServer", "device_trace",
+           "annotate", "TRACER", "Tracer", "span"]

@@ -106,6 +106,13 @@ class Metrics:
         self.h2d_inflight_depth = Gauge(
             "raphtory_h2d_inflight_depth",
             "High-water in-flight device_put window depth", registry=r)
+        self.sweep_phase_seconds = Histogram(
+            "raphtory_sweep_phase_seconds",
+            "Per-sweep wall seconds by pipeline phase (fold=host delta "
+            "fold incl. worker time, stage=host staging copies, ship=wire/"
+            "in-flight waits, compute=dispatch-loop residual incl. device "
+            "compute) — the phase breakdown the span tracer also attaches "
+            "to every sweep span", ["phase"], registry=r)
         # memory governor (Archivist signals)
         self.compactions = Counter(
             "raphtory_compactions_total",
@@ -154,4 +161,11 @@ class MetricsServer:
     def stop(self) -> None:
         if self._server is not None:
             self._server.shutdown()
+            self._server.server_close()
             self._server = None
+        if self._thread is not None:
+            # join the scrape-server thread so repeated start/stop in
+            # tests can't leak threads; a bounded wait keeps a wedged
+            # handler from hanging shutdown forever
+            self._thread.join(timeout=5.0)
+            self._thread = None

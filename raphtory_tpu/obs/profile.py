@@ -2,24 +2,49 @@
 
 The reference has no distributed tracing (SURVEY §5.1 "No spans"); on TPU
 the equivalent signal is an XLA profiler trace viewable in TensorBoard /
-xprof: per-op device timelines, HBM usage, and fusion boundaries.
+xprof: per-op device timelines, HBM usage, and fusion boundaries. Host
+phases appear on the same timeline via ``obs/trace.py`` spans, whose
+``TraceAnnotation`` twins land in the device trace.
 """
 
 from __future__ import annotations
 
 import contextlib
+import logging
 
 import jax
+
+_log = logging.getLogger(__name__)
 
 
 @contextlib.contextmanager
 def device_trace(logdir: str):
-    """Capture a JAX/XLA profiler trace for the enclosed block."""
-    jax.profiler.start_trace(logdir)
+    """Capture a JAX/XLA profiler trace for the enclosed block.
+
+    Tolerant by design: enabling tracing must never take down a sweep.
+    A failed ``start_trace`` (or one refused because a profiler session
+    is already active — e.g. nested ``device_trace`` blocks, or an
+    operator-driven capture racing a job's own) degrades to a warning
+    and a no-op, and ``stop_trace`` is only called for a session THIS
+    context actually started (never from ``finally`` on someone else's).
+    """
+    started = False
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception as e:  # noqa: BLE001 — any failure degrades to no-op
+        _log.warning("device_trace: start_trace(%s) failed (%s: %s) — "
+                     "continuing without a profiler capture",
+                     logdir, type(e).__name__, e)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                _log.warning("device_trace: stop_trace failed (%s: %s)",
+                             type(e).__name__, e)
 
 
 def annotate(name: str):

@@ -1,0 +1,408 @@
+"""Span tracing + flight recorder — one timeline from ingest event to XLA op.
+
+The reference had no distributed tracing at all (SURVEY §5.1 "No spans"):
+Kamon counters plus log lines were the only answer to "where did this
+sweep's time go". With the pipelined transfer engine overlapping fold /
+stage / ship / compute across threads, aggregate histograms can no longer
+attribute a regression to a stage — per-phase timing is the first-class
+signal of the BSP pseudo-streaming literature (arXiv:1608.07200) and of
+partition-centric phase breakdowns (arXiv:1709.07122).
+
+Three pieces, all host-side and dependency-free (stdlib only, so the
+transfer layer can use it in stripped environments):
+
+* **Spans** — ``TRACER.span(name, **attrs)`` context managers carrying
+  structured attributes (job_id, hop, superstep, bytes, stage). Spans
+  nest per thread (a thread-local stack links parent ids), and each span
+  optionally enters a ``jax.profiler.TraceAnnotation`` of the same name,
+  so host phases line up with XLA ops in an xprof capture.
+* **Flight recorder** — a bounded ring (``collections.deque(maxlen=…)``)
+  of COMPLETED spans. Always cheap: when tracing is off, ``span()``
+  returns a shared no-op and records nothing; when on, a span costs two
+  ``perf_counter_ns`` calls plus one dict append. The ring survives
+  crashes of everything except the process — dump it on failure and the
+  last N spans tell you what the system was doing.
+* **Chrome trace-event exporter** — ``chrome_trace()`` / ``dump()``
+  produce Perfetto / ``chrome://tracing`` compatible JSON: one ``X``
+  (complete) event per span, one track per thread (``M`` thread-name
+  metadata events), instants (``ph: "i"``) for watermark advances and
+  stalls.
+
+Knobs
+-----
+* ``RTPU_TRACE`` — enable tracing at import (default off). Runtime
+  toggles: ``TRACER.enable()`` / ``TRACER.disable()`` or the REST
+  ``/tracez?enable=1`` endpoint.
+* ``RTPU_TRACE_RING`` — flight-recorder capacity in spans (default 4096).
+* ``RTPU_TRACE_DUMP`` — a file path; implies tracing on, and the ring is
+  written there at interpreter exit (the CI failure-artifact hook).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import tempfile
+import threading
+import time
+
+DEFAULT_RING = 4096
+
+
+class _NullSpan:
+    """Shared do-nothing span — what ``span()`` returns when tracing is
+    off, so disabled tracing costs one attribute check per call site."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+#: lazily-resolved jax.profiler.TraceAnnotation (False = unavailable) —
+#: jax must never be a hard dependency of this module
+_ANNOTATION = None
+
+
+def _annotation_cls():
+    global _ANNOTATION
+    if _ANNOTATION is None:
+        try:
+            import jax
+
+            _ANNOTATION = jax.profiler.TraceAnnotation
+        except Exception:
+            _ANNOTATION = False
+    return _ANNOTATION
+
+
+class Span:
+    """One in-flight span. Enter/exit on the SAME thread (the per-thread
+    parent stack assumes it); attributes are plain JSON-able values."""
+
+    __slots__ = ("name", "attrs", "sid", "parent", "_tracer", "_tid",
+                 "_t0", "_ann")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self._tracer = tracer
+        self.sid = next(tracer._ids)
+        self.parent = 0
+        self._tid = 0
+        self._t0 = 0
+        self._ann = None
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        tr = self._tracer
+        t = threading.current_thread()
+        self._tid = t.ident or 0
+        if self._tid not in tr._threads:
+            tr._note_thread(self._tid, t.name)
+        stack = tr._stack()
+        self.parent = stack[-1].sid if stack else 0
+        stack.append(self)
+        cls = _annotation_cls() if tr.annotate else False
+        if cls:
+            try:
+                self._ann = cls(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, et, ev, tb):
+        dur_ns = time.perf_counter_ns() - self._t0
+        tr = self._tracer
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(et, ev, tb)
+            except Exception:
+                pass
+        stack = tr._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:   # mismatched exits must not corrupt nesting
+            stack.remove(self)
+        if et is not None:
+            self.attrs["error"] = f"{et.__name__}: {ev}"
+        tr._record({
+            "ph": "X", "name": self.name,
+            "ts": (self._t0 - tr._epoch_ns) / 1e3,     # µs, tracer epoch
+            "dur": dur_ns / 1e3,
+            "pid": tr._pid, "tid": self._tid,
+            "sid": self.sid, "parent": self.parent,
+            "args": self.attrs,
+        })
+        return False
+
+
+class Tracer:
+    """Thread-safe span tracer + bounded flight recorder.
+
+    The module-level ``TRACER`` is the process singleton every
+    instrumented layer uses; tests build private instances.
+    """
+
+    def __init__(self, enabled: bool | None = None, ring: int | None = None,
+                 annotate: bool = True):
+        env = os.environ
+        if enabled is None:
+            enabled = (env.get("RTPU_TRACE", "0") not in ("", "0", "false")
+                       or bool(env.get("RTPU_TRACE_DUMP")))
+        if ring is None:
+            try:
+                ring = int(env.get("RTPU_TRACE_RING", DEFAULT_RING))
+            except ValueError:
+                ring = DEFAULT_RING
+        self.enabled = bool(enabled)
+        self.annotate = bool(annotate)
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(16, int(ring)))
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()   # guards _recorded + ring append
+        self._recorded = 0
+        self._local = threading.local()
+        self._epoch_ns = time.perf_counter_ns()
+        self._epoch_unix = time.time()
+        self._pid = os.getpid()
+        self._threads: dict[int, str] = {}
+        self._dump_dir: str | None = None   # lazy private dir for dump()
+
+    # ---- recording ----
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _prune_threads(self, referenced: set | None = None) -> None:
+        """Drop name entries for threads the ring no longer references
+        (dead job threads) — called from exports, and from registration
+        once the map outgrows the ring it annotates. The ring and the
+        name map are snapshotted via atomic C-level copies before
+        iterating: concurrent span exits keep appending, and iterating
+        the live deque/dict would raise mid-export."""
+        if referenced is None:
+            referenced = {e["tid"] for e in list(self._ring)}
+        live = {t.ident for t in threading.enumerate()}
+        self._threads = {tid: name
+                         for tid, name in dict(self._threads).items()
+                         if tid in referenced or tid in live}
+
+    def _note_thread(self, tid: int, name: str) -> None:
+        self._threads[tid] = name
+        if len(self._threads) > max(256, self.ring_size):
+            self._prune_threads()
+
+    def _record(self, event: dict) -> None:
+        # the bounded-deque append itself is GIL-atomic, but the recorded
+        # counter must stay exact under concurrent writers (the eviction
+        # count in /statusz derives from it) — one uncontended lock
+        # acquire per COMPLETED span is noise next to building the event
+        with self._lock:
+            self._recorded += 1
+            self._ring.append(event)
+
+    def span(self, name: str, **attrs):
+        """Context-manager span; no-op (and ~free) when tracing is off."""
+        if not self.enabled:
+            return NULL_SPAN
+        return Span(self, name, attrs)
+
+    def instant(self, name: str, **attrs) -> None:
+        """Zero-duration marker (watermark advances, state flips)."""
+        if not self.enabled:
+            return
+        t = threading.current_thread()
+        tid = t.ident or 0
+        if tid not in self._threads:
+            self._note_thread(tid, t.name)
+        self._record({
+            "ph": "i", "s": "t", "name": name,
+            "ts": (time.perf_counter_ns() - self._epoch_ns) / 1e3,
+            "pid": self._pid, "tid": tid, "args": attrs,
+        })
+
+    def complete(self, name: str, dur_s: float, **attrs) -> None:
+        """Record a span that already happened (e.g. a measured stall whose
+        wait ran inside another primitive) as an X event ending now."""
+        if not self.enabled:
+            return
+        t = threading.current_thread()
+        tid = t.ident or 0
+        if tid not in self._threads:
+            self._note_thread(tid, t.name)
+        now = time.perf_counter_ns()
+        dur_ns = max(0.0, float(dur_s)) * 1e9
+        self._record({
+            "ph": "X", "name": name,
+            "ts": (now - dur_ns - self._epoch_ns) / 1e3,
+            "dur": dur_ns / 1e3,
+            "pid": self._pid, "tid": tid, "sid": next(self._ids),
+            "parent": 0, "args": attrs,
+        })
+
+    # ---- lifecycle ----
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._recorded = 0
+
+    # ---- introspection / export ----
+
+    @property
+    def ring_size(self) -> int:
+        return self._ring.maxlen or 0
+
+    @property
+    def recorded(self) -> int:
+        """Events seen since start/clear (≥ len(ring) once it wraps)."""
+        return self._recorded
+
+    @property
+    def dropped(self) -> int:
+        """Events evicted from the ring by newer ones."""
+        return max(0, self._recorded - len(self._ring))
+
+    def recent(self, n: int = 200) -> list[dict]:
+        """The newest ``n`` completed events, oldest first (a snapshot —
+        safe against concurrent writers)."""
+        n = int(n)
+        if n <= 0:
+            return []
+        snap = list(self._ring)
+        return snap[-n:]
+
+    def chrome_trace(self) -> dict:
+        """Perfetto / chrome://tracing compatible trace-event JSON dict:
+        the ring's events plus thread-name metadata (one track per
+        thread). Only threads the CURRENT ring references get a metadata
+        row — a long-lived server churns through one thread per job, and
+        emitting (or retaining, see ``_prune_threads``) every thread ever
+        seen would grow without bound."""
+        events = list(self._ring)   # atomic snapshot — writers keep going
+        referenced = {e["tid"] for e in events}
+        self._prune_threads(referenced)
+        meta = [{
+            "ph": "M", "name": "thread_name", "pid": self._pid, "tid": tid,
+            "args": {"name": name},
+        } for tid, name in sorted(dict(self._threads).items())
+            if tid in referenced]
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "epoch_unix": self._epoch_unix,
+                "recorded": self._recorded,
+                "dropped": self.dropped,
+            },
+        }
+
+    def dump(self, path: str | None = None) -> str:
+        """Write the Chrome trace JSON to ``path`` and return the path.
+        The default is one STABLE per-process file, overwritten on each
+        call — a monitor polling ``/tracez?dump=1`` must refresh a
+        snapshot, not accumulate thousands of files — inside a private
+        mkdtemp (mode 0700) directory: a predictable world-writable /tmp
+        name would let another local user pre-plant a symlink and turn
+        the remotely-triggerable dump into a file-clobber primitive."""
+        if path is None:
+            if self._dump_dir is None:
+                self._dump_dir = tempfile.mkdtemp(prefix="rtpu_trace_")
+            path = os.path.join(self._dump_dir, "trace.json")
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f, default=str)
+        return path
+
+    def status(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "ring_size": self.ring_size,
+            "recorded": self._recorded,
+            "buffered": len(self._ring),
+            "dropped": self.dropped,
+        }
+
+
+#: process-wide tracer every instrumented layer records into
+TRACER = Tracer()
+
+
+def span(name: str, **attrs):
+    """Module-level convenience for ``TRACER.span``."""
+    return TRACER.span(name, **attrs)
+
+
+def block_steps(fn):
+    """Run ``fn() -> (value, steps)`` — a device barrier where a compiled
+    program's results land — under ONE ``superstep.block`` span carrying
+    the superstep count. The single definition of the barrier span shared
+    by the engine layer (``bsp.run``) and every jobs-layer emit path."""
+    with TRACER.span("superstep.block") as sp:
+        value, steps = fn()
+        steps = int(steps)
+        sp.set(steps=steps)
+    return value, steps
+
+
+_dump_path = os.environ.get("RTPU_TRACE_DUMP")
+if _dump_path:
+    import atexit
+
+    def _dump_at_exit(path=_dump_path):
+        try:
+            if len(TRACER._ring):
+                TRACER.dump(path)
+        except Exception:
+            pass
+
+    atexit.register(_dump_at_exit)
+
+    def _install_sigterm_dump() -> None:
+        """A wedged run killed by ``timeout`` (SIGTERM) skips atexit under
+        Python's default handler — exactly the case the CI failure
+        artifact exists for. Install a dump-then-default handler, but
+        only from the main thread and only when nothing else has claimed
+        SIGTERM (a server's own shutdown handler must win)."""
+        try:
+            import signal
+
+            if (threading.current_thread() is not threading.main_thread()
+                    or signal.getsignal(signal.SIGTERM)
+                    is not signal.SIG_DFL):
+                return
+
+            def _on_term(signum, frame):
+                _dump_at_exit()
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)   # keep exit code 143
+
+            signal.signal(signal.SIGTERM, _on_term)
+        except Exception:
+            pass
+
+    _install_sigterm_dump()

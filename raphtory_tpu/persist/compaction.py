@@ -27,6 +27,7 @@ import time as _time
 import numpy as np
 
 from ..obs.metrics import METRICS
+from ..obs.trace import TRACER
 
 from ..core import events as ev
 from ..core.events import EventLog
@@ -261,22 +262,30 @@ class Archivist:
         # the EventLog object (pipelines, views) sees the compacted history;
         # nothing is stranded or lost.
         t0 = _time.perf_counter()
-        frozen = log.freeze()
-        span = log.max_time - log.min_time
-        new_log = frozen
-        if self.compressing:
-            c_cut = log.min_time + int(span * self.compress_fraction)
-            new_log = compress_events(new_log, c_cut)
-        if self.archiving:
-            a_cut = log.min_time + int(span * self.archive_fraction) + 1
-            new_log = archive_events(new_log, a_cut)
-        if new_log.n >= frozen.n:
-            # nothing shrank (e.g. compress-only on already-compressed
-            # history) — skip the splice, or every governor tick would
-            # rewrite the whole log and invalidate caches for nothing
-            return False
-        log.compact_to(new_log, since_row=frozen.n)
-        self.graph.invalidate_cache()
+        with TRACER.span("compact.cycle", events_before=int(log.n),
+                         compressing=self.compressing,
+                         archiving=self.archiving) as tsp:
+            frozen = log.freeze()
+            span = log.max_time - log.min_time
+            new_log = frozen
+            if self.compressing:
+                c_cut = log.min_time + int(span * self.compress_fraction)
+                with TRACER.span("compact.compress", cutoff=int(c_cut)):
+                    new_log = compress_events(new_log, c_cut)
+            if self.archiving:
+                a_cut = log.min_time + int(span * self.archive_fraction) + 1
+                with TRACER.span("compact.archive", cutoff=int(a_cut)):
+                    new_log = archive_events(new_log, a_cut)
+            tsp.set(events_after=int(new_log.n))
+            if new_log.n >= frozen.n:
+                # nothing shrank (e.g. compress-only on already-compressed
+                # history) — skip the splice, or every governor tick would
+                # rewrite the whole log and invalidate caches for nothing
+                tsp.set(spliced=False)
+                return False
+            log.compact_to(new_log, since_row=frozen.n)
+            self.graph.invalidate_cache()
+            tsp.set(spliced=True)
         # counters record compactions that actually landed
         if self.compressing:
             METRICS.compactions.labels("compress").inc()

@@ -37,6 +37,10 @@ Knobs and telemetry
   ``raphtory_h2d_bytes_total``, ``raphtory_h2d_slices_total``,
   ``raphtory_h2d_retries_total``, ``raphtory_h2d_stall_seconds_total
   {stage}``, ``raphtory_h2d_inflight_depth``.
+* Per-slice spans in the flight recorder when ``obs.trace`` is importable
+  and tracing is on (``RTPU_TRACE``): ``ship.stage`` / ``ship.wire`` /
+  ``ship.retry`` with byte counts — stalls as timeline children of the
+  sweep, not just counters (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -113,6 +117,22 @@ def _metrics():
     return _METRICS
 
 
+_TRACER = None
+
+
+def _tracer():
+    """The process tracer (``obs.trace.TRACER``) — imported lazily so the
+    transfer layer stays import-light. ``obs.trace`` is stdlib-only and
+    ``obs/__init__`` guards its prometheus/jax imports, so this works in
+    the same stripped environments ``_metrics()`` degrades in."""
+    global _TRACER
+    if _TRACER is None:
+        from ..obs.trace import TRACER
+
+        _TRACER = TRACER
+    return _TRACER
+
+
 @dataclass
 class TransferStats:
     """Cumulative pipeline telemetry for one engine (or the shared one)."""
@@ -183,7 +203,8 @@ class TransferEngine:
         """Contiguous host copy of one slice (no-op view when already
         contiguous) — the pipeline's host-memcpy stage."""
         t0 = time.perf_counter()
-        staged = np.ascontiguousarray(a)
+        with _tracer().span("ship.stage", bytes=int(a.nbytes)):
+            staged = np.ascontiguousarray(a)
         dt = time.perf_counter() - t0
         self.stats.stage_seconds += dt
         m = _metrics()
@@ -226,8 +247,10 @@ class TransferEngine:
             if m is not None:
                 m.h2d_retries.inc()
             try:
-                x = jax.device_put(staged, self.device)
-                x.block_until_ready()   # surface transport errors HERE
+                with _tracer().span("ship.retry", attempt=attempt,
+                                    bytes=int(staged.nbytes)):
+                    x = jax.device_put(staged, self.device)
+                    x.block_until_ready()   # surface transport errors HERE
                 return x
             except Exception as e:  # noqa: BLE001 — classified below
                 if not _is_transient(e):
@@ -241,12 +264,13 @@ class TransferEngine:
         x, staged = item
         t0 = time.perf_counter()
         if staged is not None:   # None: already completed at issue time
-            try:
-                x.block_until_ready()
-            except Exception as e:  # noqa: BLE001 — classified below
-                if not _is_transient(e):
-                    raise
-                x = self._retry(staged, e)
+            with _tracer().span("ship.wire", bytes=int(staged.nbytes)):
+                try:
+                    x.block_until_ready()
+                except Exception as e:  # noqa: BLE001 — classified below
+                    if not _is_transient(e):
+                        raise
+                    x = self._retry(staged, e)
         dt = time.perf_counter() - t0
         self.stats.wire_seconds += dt
         m = _metrics()

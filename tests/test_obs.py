@@ -79,6 +79,48 @@ def test_profiler_annotation_and_trace(tmp_path):
     assert any(tmp_path.rglob("*"))
 
 
+def test_metrics_server_repeated_start_stop_leaks_no_threads():
+    import threading
+
+    for _ in range(3):
+        srv = MetricsServer(port=0)
+        srv.start()
+        t = srv._thread
+        assert t is not None and t.is_alive()
+        srv.stop()
+        # stop() joins the scrape thread and drops the handle, so
+        # repeated start/stop cycles cannot accumulate live threads
+        assert srv._thread is None and srv._server is None
+        assert not t.is_alive()
+        assert t not in threading.enumerate()
+
+
+def test_device_trace_tolerates_nested_and_failed_sessions(tmp_path):
+    import jax.numpy as jnp
+
+    # nested sessions: the inner start_trace is refused by the profiler —
+    # device_trace must warn + no-op, never raise (and must not stop the
+    # OUTER session from its finally)
+    with device_trace(str(tmp_path / "outer")):
+        with device_trace(str(tmp_path / "inner")):
+            jnp.ones(4).sum().block_until_ready()
+        # the outer session is still active here and stops cleanly below
+        jnp.ones(4).sum().block_until_ready()
+    assert any((tmp_path / "outer").rglob("*"))
+
+    # a start_trace that raises outright also degrades to a no-op
+    import raphtory_tpu.obs.profile as prof
+
+    orig = prof.jax.profiler.start_trace
+    prof.jax.profiler.start_trace = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("no profiler backend"))
+    try:
+        with device_trace(str(tmp_path / "broken")):
+            jnp.ones(4).sum().block_until_ready()   # sweep survives
+    finally:
+        prof.jax.profiler.start_trace = orig
+
+
 def test_records_dropped_counter():
     from raphtory_tpu.ingestion.source import IterableSource
     from raphtory_tpu.examples import RandomJsonParser

@@ -1,0 +1,279 @@
+"""Span tracer + flight recorder + /tracez //statusz //healthz surface.
+
+Covers the PR-3 acceptance line end to end: span nesting/attributes and
+ring eviction under concurrent writers, Chrome trace-event export schema,
+the live REST endpoints, and an RTPU_TRACE'd range sweep producing the
+job → sweep → hop → {fold, stage, ship, compute} → superstep timeline.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from raphtory_tpu.obs.trace import TRACER, NULL_SPAN, Tracer
+
+
+@pytest.fixture
+def global_trace():
+    """Enable the process tracer for one test, restoring prior state (CI
+    may run the whole tier with RTPU_TRACE_DUMP, i.e. tracing already on)."""
+    was = TRACER.enabled
+    TRACER.enable()
+    try:
+        yield TRACER
+    finally:
+        TRACER.enabled = was
+
+
+def test_span_nesting_and_attributes():
+    tr = Tracer(enabled=True, ring=64)
+    with tr.span("outer", job_id="j1") as outer:
+        with tr.span("inner", hop=3, bytes=128) as inner:
+            inner.set(extra="late")
+        assert inner.parent == outer.sid
+    assert tr.recent(0) == [] and tr.recent(-1) == []
+    events = tr.recent(10)
+    assert [e["name"] for e in events] == ["inner", "outer"]  # exit order
+    by_name = {e["name"]: e for e in events}
+    assert by_name["inner"]["parent"] == by_name["outer"]["sid"]
+    assert by_name["outer"]["parent"] == 0
+    assert by_name["inner"]["args"] == {"hop": 3, "bytes": 128,
+                                        "extra": "late"}
+    assert by_name["outer"]["args"] == {"job_id": "j1"}
+    # inner nests inside outer on the timeline too
+    assert by_name["outer"]["ts"] <= by_name["inner"]["ts"]
+    assert by_name["inner"]["dur"] <= by_name["outer"]["dur"]
+
+
+def test_span_records_error_and_unwinds_stack():
+    tr = Tracer(enabled=True, ring=64)
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("nope")
+    (ev,) = tr.recent(10)
+    assert ev["args"]["error"].startswith("ValueError")
+    with tr.span("after") as sp:
+        assert sp.parent == 0   # the failed span was popped
+
+
+def test_disabled_tracer_is_free_and_records_nothing():
+    tr = Tracer(enabled=False, ring=64)
+    assert tr.span("x", a=1) is NULL_SPAN
+    with tr.span("x"):
+        pass
+    tr.instant("i")
+    tr.complete("c", 0.1)
+    assert tr.recorded == 0 and tr.recent(10) == []
+
+
+def test_ring_eviction_under_concurrent_writers():
+    tr = Tracer(enabled=True, ring=64)
+    n_threads, per_thread = 8, 200
+
+    def writer(k):
+        for i in range(per_thread):
+            with tr.span("w", thread=k, i=i):
+                pass
+
+    threads = [threading.Thread(target=writer, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per_thread
+    assert tr.recorded == total
+    assert len(tr.recent(10**6)) == 64          # bounded: only newest kept
+    assert tr.dropped == total - 64
+    # every surviving event is intact (no torn writes)
+    for e in tr.recent(10**6):
+        assert e["name"] == "w" and {"thread", "i"} <= set(e["args"])
+
+
+def test_instant_and_complete_events():
+    tr = Tracer(enabled=True, ring=64)
+    tr.instant("watermark.advance", source="s1", watermark=42)
+    tr.complete("fold.stall", 0.25, hops=3)
+    inst, comp = tr.recent(10)
+    assert inst["ph"] == "i" and inst["args"]["watermark"] == 42
+    assert comp["ph"] == "X" and comp["dur"] == pytest.approx(250_000, rel=0.01)
+
+
+def test_chrome_trace_export_schema(tmp_path):
+    tr = Tracer(enabled=True, ring=64)
+    with tr.span("a", x=1):
+        with tr.span("b"):
+            pass
+    tr.instant("mark")
+    doc = tr.chrome_trace()
+    # round-trips through JSON (the loadability half of the acceptance)
+    doc = json.loads(json.dumps(doc))
+    events = doc["traceEvents"]
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               for e in events)
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == 2
+    for e in xs:   # required trace-event schema fields
+        for field in ("ph", "ts", "dur", "pid", "tid", "name"):
+            assert field in e, field
+        assert e["dur"] >= 0 and e["ts"] >= 0
+    for e in events:
+        if e["ph"] == "i":
+            assert {"ts", "pid", "tid", "name"} <= set(e)
+    # dump writes the same document to disk
+    path = tr.dump(str(tmp_path / "trace.json"))
+    on_disk = json.loads(open(path).read())
+    assert len(on_disk["traceEvents"]) == len(events)
+
+
+def _graph(n=3_000, name="tr1", seed=2):
+    from raphtory_tpu.core.service import TemporalGraph
+    from raphtory_tpu.ingestion.pipeline import IngestionPipeline
+    from raphtory_tpu.ingestion.source import RandomSource
+
+    pipe = IngestionPipeline()
+    pipe.add_source(RandomSource(n, id_pool=200, seed=seed, name=name))
+    pipe.run()
+    return TemporalGraph(pipe.log, pipe.watermarks)
+
+
+def test_range_sweep_produces_full_span_timeline(global_trace):
+    """Acceptance: a range-sweep run yields a loadable Chrome trace with
+    spans for job → sweep → hop → {fold, stage, ship, compute} →
+    superstep, and the per-sweep phase breakdown rides the sweep span."""
+    import numpy as np
+
+    from raphtory_tpu.algorithms import PageRank
+    from raphtory_tpu.engine.device_sweep import DeviceSweep
+    from raphtory_tpu.jobs.manager import AnalysisManager, RangeQuery
+
+    TRACER.clear()
+    g = _graph(name="tr_sweep", seed=5)
+    # engine-level pipelined sweep: hop.ship comes from the staged applies
+    ds = DeviceSweep(g.log)
+    pr = PageRank(max_steps=10)
+    res, _ = ds.run_sweep(pr, [300, 600, 900], windows=[10_000, 100])
+    np.asarray(res[-1])
+    assert set(ds.last_phase_seconds) == {"fold", "stage", "ship", "compute"}
+    # job-level: the full chain through the analysis manager
+    job = AnalysisManager(g).submit(
+        PageRank(max_steps=10), RangeQuery(200, 900, 350,
+                                           windows=(10_000, 100)))
+    assert job.wait(120) and job.status == "done", job.error
+
+    doc = json.loads(json.dumps(TRACER.chrome_trace()))
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    for e in xs:
+        for field in ("ph", "ts", "dur", "pid", "tid", "name"):
+            assert field in e, field
+    names = {e["name"] for e in xs}
+    assert "job" in names
+    assert {"sweep.range", "sweep.columnar"} & names
+    assert "hop.fold" in names
+    assert "ship.stage" in names     # host staging copies
+    assert "ship.wire" in names      # wire/in-flight completion waits
+    assert "hop.ship" in names       # device-sweep staged applies
+    assert "hop.compute" in names
+    assert "superstep.block" in names
+    job_ev = next(e for e in xs if e["name"] == "job")
+    assert job_ev["args"]["job_id"] == job.id
+    assert job_ev["args"]["status"] == "done"
+    sweep_ev = next(e for e in xs if e["name"].startswith("sweep."))
+    assert {"fold_seconds", "stage_seconds", "ship_seconds",
+            "compute_seconds", "n_hops"} <= set(sweep_ev["args"])
+
+
+def test_endpoints_over_live_rest_server(global_trace):
+    from raphtory_tpu.algorithms import DegreeBasic
+    from raphtory_tpu.jobs.manager import AnalysisManager, ViewQuery
+    from raphtory_tpu.jobs.rest import RestServer
+
+    g = _graph(name="tr_rest", seed=7)
+    g.view_at(int(g.latest_time))   # cold fold → a snapshot.fold span
+    mgr = AnalysisManager(g)
+    job = mgr.submit(DegreeBasic(), ViewQuery(g.latest_time))
+    assert job.wait(120) and job.status == "done", job.error
+    srv = RestServer(mgr, port=0).start()
+    try:
+        def get(path):
+            return json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}{path}", timeout=10).read())
+
+        assert get("/healthz") == {"status": "ok"}
+
+        st = get("/statusz")
+        assert st["jobs"][job.id] == "done"
+        assert st["log_events"] == g.log.n
+        assert st["watermark"]["safe_time"] >= g.latest_time
+        assert "tr_rest" in st["watermark"]["sources"]
+        assert st["transfer"]["depth"] >= 1
+        assert "bsp._compiled_runner" in st["compile_caches"]
+        assert st["trace"]["enabled"] is True
+
+        tz = get("/tracez?n=500")
+        assert tz["enabled"] is True
+        names = {e["name"] for e in tz["spans"]}
+        assert "job" in names and "snapshot.fold" in names
+        # full chrome document over the wire
+        chrome = get("/tracez?format=chrome")["trace"]
+        assert any(e["ph"] == "M" for e in chrome["traceEvents"])
+        # runtime toggle round-trip
+        assert get("/tracez?enable=0")["enabled"] is False
+        assert get("/tracez?enable=1")["enabled"] is True
+    finally:
+        srv.stop()
+
+
+def test_tracez_dump_writes_server_side_file(global_trace, tmp_path):
+    from raphtory_tpu.jobs.manager import AnalysisManager
+    from raphtory_tpu.jobs.rest import RestServer
+
+    with TRACER.span("dumpme"):
+        pass
+    srv = RestServer(AnalysisManager(_graph(500, name="tr_dump")),
+                     port=0).start()
+    try:
+        out = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/tracez?dump=1", timeout=10).read())
+        assert "dumped" in out
+        doc = json.loads(open(out["dumped"]).read())
+        assert any(e.get("name") == "dumpme" for e in doc["traceEvents"])
+    finally:
+        srv.stop()
+
+
+def test_watermark_and_ingest_spans(global_trace):
+    TRACER.clear()
+    _graph(1_000, name="tr_wm", seed=9)
+    names = {e["name"] for e in TRACER.recent(10**6)}
+    assert "ingest.source" in names
+    assert "ingest.append" in names
+    assert "watermark.advance" in names
+    assert "watermark.finish" in names
+    app = next(e for e in TRACER.recent(10**6)
+               if e["name"] == "ingest.append")
+    assert app["args"]["source"] == "tr_wm" and app["args"]["events"] > 0
+
+
+def test_sweep_phase_histogram_observed(global_trace):
+    from raphtory_tpu.algorithms import PageRank
+    from raphtory_tpu.engine.device_sweep import DeviceSweep
+    from raphtory_tpu.obs.metrics import METRICS
+
+    def hist_count(phase):
+        for metric in METRICS.sweep_phase_seconds.collect():
+            for s in metric.samples:
+                if (s.name.endswith("_count")
+                        and s.labels.get("phase") == phase):
+                    return s.value
+        return 0.0
+
+    before = {ph: hist_count(ph)
+              for ph in ("fold", "stage", "ship", "compute")}
+    g = _graph(name="tr_hist", seed=11)
+    ds = DeviceSweep(g.log)
+    ds.run_sweep(PageRank(max_steps=5), [400, 800], windows=[10_000])
+    for ph, prev in before.items():
+        assert hist_count(ph) == prev + 1, ph
