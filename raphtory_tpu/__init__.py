@@ -16,6 +16,14 @@ if _os.environ.get("RAPHTORY_TPU_X64", "1") != "0":
 
     _jax.config.update("jax_enable_x64", True)
 
+# RTPU_SANITIZE=1 installs the lock sanitizer before any package module
+# creates its locks (cycle + held-across-device_put findings land in the
+# flight recorder). Disabled: this costs one env read and imports nothing.
+if _os.environ.get("RTPU_SANITIZE", "0") not in ("", "0", "false"):
+    from .analysis.sanitizer import maybe_install_from_env as _mi
+
+    _mi()
+
 from .core.events import EventLog
 from .core.snapshot import GraphView, build_view
 from .engine import bsp

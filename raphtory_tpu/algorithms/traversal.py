@@ -7,7 +7,7 @@ property (default weight 1). Both are the same min-plus program.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax.numpy as jnp
 import numpy as np

@@ -1,0 +1,40 @@
+"""rtpulint — project-specific static analysis + runtime lock sanitizer.
+
+The sweep engines rely on invariants nothing in Python enforces: compiled-
+program cache keys must capture every tuning knob, donated buffers must
+never be reused, traced code must not sync with the host, and the threaded
+ingest/transfer/REST paths must take locks in one global order. Each rule
+here encodes one invariant the project has already been bitten by (the
+round-5 advisor caught the ``RTPU_TILE_BUDGET_MB``-not-in-cache-key bug and
+a bare-``Exception`` retry loop by hand — this package turns those reviews
+into CI gates).
+
+Two halves:
+
+* **Static rules** (``rules.py``) — AST passes over the package, run by
+  ``tools/rtpulint`` (or ``python -m raphtory_tpu.analysis``) against a
+  checked-in baseline so CI fails only on NEW violations. Rule catalogue
+  and suppression syntax: ``docs/STATIC_ANALYSIS.md``.
+* **Lock sanitizer** (``sanitizer.py``) — ``RTPU_SANITIZE=1`` wraps
+  ``threading.Lock``/``RLock`` to build a lock-ordering graph, reports
+  cycles (potential deadlocks) and locks held across ``device_put`` /
+  compile boundaries, and mirrors findings into the ``obs.trace`` flight
+  recorder. Zero overhead when the env var is unset: nothing is patched.
+"""
+
+from __future__ import annotations
+
+from .findings import Baseline, Finding
+from .rules import RULES, analyze_module, analyze_project
+from .sanitizer import LockSanitizer, install, uninstall
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "RULES",
+    "analyze_module",
+    "analyze_project",
+    "LockSanitizer",
+    "install",
+    "uninstall",
+]

@@ -1,0 +1,7 @@
+"""``python -m raphtory_tpu.analysis`` — same entry as ``tools/rtpulint``."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

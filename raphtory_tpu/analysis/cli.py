@@ -1,0 +1,155 @@
+"""rtpulint command line — scan, baseline-diff, report.
+
+``tools/rtpulint raphtory_tpu/`` is the CI entry point: exit 0 when every
+finding is covered by the checked-in baseline, exit 1 on new findings (or
+parse errors), exit 2 on usage errors. ``--write-baseline`` refreshes the
+baseline after a reviewed change; ``--format json`` emits the machine
+report CI uploads as an artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .findings import Baseline
+from .rules import RULES, analyze_project
+
+DEFAULT_BASELINE = os.path.join("tools", "rtpulint_baseline.json")
+DEFAULT_DOCS = os.path.join("docs", "OPERATIONS.md")
+
+
+def _iter_py_files(paths: list[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            out.extend(os.path.join(dirpath, f) for f in sorted(filenames)
+                       if f.endswith(".py"))
+    return out
+
+
+def _load(path: str, root: str) -> tuple[str, str]:
+    rel = os.path.relpath(path, root)
+    with open(path, encoding="utf-8") as fh:
+        return rel.replace(os.sep, "/"), fh.read()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="rtpulint",
+        description="project-specific static analysis for raphtory_tpu "
+                    "(rule catalogue: docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("paths", nargs="+", help="files or directories to scan")
+    ap.add_argument("--root", default=".",
+                    help="repo root findings are reported relative to "
+                         "(default: cwd)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline json (default: <root>/{DEFAULT_BASELINE} "
+                         f"when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline: report every finding as new")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept the current findings as the new baseline")
+    ap.add_argument("--docs", default=None,
+                    help=f"knob-table doc for undocumented-knob "
+                         f"(default: <root>/{DEFAULT_DOCS})")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="RULE", help="only run the named rule(s) "
+                    "(id or slug; repeatable)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--output", default=None,
+                    help="also write the json report here (any --format)")
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root)
+    files = _iter_py_files(args.paths)
+    if not files:
+        print("rtpulint: no python files under " + ", ".join(args.paths),
+              file=sys.stderr)
+        return 2
+
+    docs_path = args.docs or os.path.join(root, DEFAULT_DOCS)
+    docs_text = ""
+    if os.path.exists(docs_path):
+        with open(docs_path, encoding="utf-8") as fh:
+            docs_text = fh.read()
+    docs_name = os.path.relpath(docs_path, root).replace(os.sep, "/")
+
+    rules = None
+    if args.rule:
+        rules = set()
+        slugs = {v: k for k, v in RULES.items()}
+        for r in args.rule:
+            if r not in RULES and r not in slugs:
+                print(f"rtpulint: unknown rule {r!r} "
+                      f"(known: {', '.join(sorted(RULES))} / "
+                      f"{', '.join(sorted(slugs))})", file=sys.stderr)
+                return 2
+            rules.add(RULES.get(r, r))
+            rules.add(slugs.get(r, r))
+
+    findings = analyze_project([_load(f, root) for f in files],
+                               docs_text=docs_text, docs_name=docs_name,
+                               rules=rules)
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    if args.write_baseline:
+        if args.rule:
+            # a filtered run only saw a slice of the findings — writing it
+            # would silently drop every other rule's accepted entries
+            print("rtpulint: refusing --write-baseline with --rule; "
+                  "run the full rule set to regenerate the baseline",
+                  file=sys.stderr)
+            return 2
+        Baseline.from_findings(findings).save(baseline_path)
+        print(f"rtpulint: wrote {len(findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    baseline = Baseline()
+    baseline_used = False
+    if not args.no_baseline and os.path.exists(baseline_path):
+        baseline = Baseline.load(baseline_path)
+        baseline_used = True
+    new, accepted, stale = baseline.split(findings)
+
+    report = {
+        "tool": "rtpulint",
+        "files_scanned": len(files),
+        "rules": sorted(RULES.values()),
+        "baseline": baseline_path if baseline_used else None,
+        "total": len(findings),
+        "new": [f.as_dict() for f in new],
+        "accepted": [f.as_dict() for f in accepted],
+        "stale_baseline_entries": stale,
+    }
+    if args.output:
+        with open(args.output, "w") as fh:
+            json.dump(report, fh, indent=1)
+            fh.write("\n")
+
+    if args.format == "json":
+        json.dump(report, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        for f in new:
+            print(f.render())
+        tail = (f"rtpulint: {len(files)} files, {len(findings)} finding(s): "
+                f"{len(new)} new, {len(accepted)} baselined")
+        if stale:
+            tail += (f", {stale} stale baseline entr"
+                     f"{'y' if stale == 1 else 'ies'} (consider "
+                     f"--write-baseline)")
+        print(tail)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,128 @@
+"""Finding / suppression / baseline plumbing for the static rules.
+
+Findings carry a *fingerprint* — a hash of (rule, file, enclosing symbol,
+normalized source line) that deliberately excludes the line NUMBER, so code
+motion above a known violation does not churn the baseline. The baseline
+is multiset-semantic: two identical lines in one function are two entries,
+and a third copy is a new finding.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+
+#: inline pragma: ``# rtpulint: disable=rule-a,RT002`` — suppresses matching
+#: findings on the SAME line, or (as a standalone comment) on the next line.
+_PRAGMA = re.compile(r"#\s*rtpulint:\s*disable=([A-Za-z0-9_,\-\s]+)")
+
+
+@dataclass
+class Finding:
+    rule: str           # rule id, e.g. "RT001"
+    name: str           # rule slug, e.g. "env-not-in-cache-key"
+    path: str           # path relative to the scan root
+    line: int           # 1-based
+    col: int
+    message: str
+    symbol: str = ""    # enclosing function qualname ("" at module level)
+    line_text: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        norm = " ".join(self.line_text.split())
+        raw = "\0".join((self.rule, self.path, self.symbol, norm))
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} {self.name}: {self.message}{sym}")
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule, "name": self.name, "path": self.path,
+            "line": self.line, "col": self.col, "message": self.message,
+            "symbol": self.symbol, "fingerprint": self.fingerprint,
+        }
+
+
+def parse_suppressions(lines: list[str]) -> dict[int, set[str]]:
+    """1-based line → set of suppressed rule ids/slugs (lowercased).
+
+    A pragma on a code line covers that line; a pragma on a comment-only
+    line covers the next line (for lines too long to annotate inline).
+    """
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _PRAGMA.search(text)
+        if not m:
+            continue
+        rules = {r.strip().lower() for r in m.group(1).split(",") if r.strip()}
+        target = i + 1 if text.lstrip().startswith("#") else i
+        out.setdefault(target, set()).update(rules)
+    return out
+
+
+def suppressed(f: Finding, pragmas: dict[int, set[str]]) -> bool:
+    rules = pragmas.get(f.line)
+    if not rules:
+        return False
+    return bool(rules & {f.rule.lower(), f.name.lower(), "all"})
+
+
+@dataclass
+class Baseline:
+    """Checked-in set of accepted findings; CI fails only on NEW ones."""
+
+    counts: Counter = field(default_factory=Counter)
+    entries: list[dict] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path) as fh:
+            doc = json.load(fh)
+        entries = doc.get("findings", [])
+        return cls(Counter(e["fingerprint"] for e in entries), entries)
+
+    @classmethod
+    def from_findings(cls, findings: list[Finding]) -> "Baseline":
+        # parse errors are never baselinable — accepting one would leave a
+        # file permanently unscanned while CI stays green
+        findings = [f for f in findings if f.rule != "RT000"]
+        return cls(Counter(f.fingerprint for f in findings),
+                   [f.as_dict() for f in findings])
+
+    def save(self, path: str) -> None:
+        doc = {
+            "version": 1,
+            "tool": "rtpulint",
+            "note": ("accepted findings — regenerate with "
+                     "`tools/rtpulint raphtory_tpu/ --write-baseline` "
+                     "after reviewing every new entry"),
+            "findings": sorted(self.entries, key=lambda e: (
+                e["path"], e["rule"], e["line"])),
+        }
+        with open(path, "w") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=False)
+            fh.write("\n")
+
+    def split(self, findings: list[Finding]):
+        """(new, accepted, stale_count): multiset-diff current findings
+        against the baseline."""
+        budget = Counter(self.counts)
+        new, accepted = [], []
+        for f in findings:
+            if f.rule == "RT000":
+                new.append(f)   # a hand-edited baseline entry cannot
+                continue        # launder a parse error either
+            if budget[f.fingerprint] > 0:
+                budget[f.fingerprint] -= 1
+                accepted.append(f)
+            else:
+                new.append(f)
+        stale = sum(budget.values())
+        return new, accepted, stale

@@ -1,0 +1,709 @@
+"""The rtpulint static rules — one AST pass per hazard class.
+
+Every rule encodes an invariant this codebase has already violated (or
+nearly violated) as it grew; the motivating bug for each is documented in
+``docs/STATIC_ANALYSIS.md``. Rules are deliberately *project-shaped*: they
+know the repo's idioms (compiled-program factories are ``lru_cache``'d
+module functions that close over their parameters and return
+``jax.jit(inner)``; retries back off with ``time.sleep``; env knobs live in
+the ``RTPU_*`` namespace) and trade generality for precision on exactly
+those shapes.
+
+stdlib-only on purpose: the CI lint job runs without jax installed.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+from .findings import Finding, parse_suppressions, suppressed
+
+#: rule id → slug. Adding a rule: implement ``_check_<slug_with_underscores>``
+#: below, register here, document in docs/STATIC_ANALYSIS.md.
+RULES = {
+    "RT001": "env-not-in-cache-key",
+    "RT002": "broad-except-retry",
+    "RT003": "host-sync-in-trace",
+    "RT004": "use-after-donate",
+    "RT005": "nondeterminism-in-trace",
+    "RT006": "unguarded-module-state",
+    "RT007": "undocumented-knob",
+    "RT008": "unused-import",
+}
+
+_ENV_VAR_RE = re.compile(r"^RTPU_[A-Z0-9_]+$")
+
+_CACHE_DECORATORS = {"lru_cache", "cache"}
+_JIT_NAMES = {"jit"}
+_MUTATOR_METHODS = {
+    "append", "add", "update", "setdefault", "insert", "extend", "pop",
+    "popleft", "appendleft", "remove", "discard", "clear", "__setitem__",
+}
+_MUTABLE_FACTORIES = {
+    "dict", "list", "set", "defaultdict", "deque", "OrderedDict", "Counter",
+}
+_NONDET_CALLS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.perf_counter",
+    "time.perf_counter_ns", "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "uuid.uuid4",
+}
+_NONDET_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+
+# ---------------------------------------------------------------------------
+# module model
+
+
+def _set_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._rtpu_parent = node  # type: ignore[attr-defined]
+
+
+def _parent(node: ast.AST):
+    return getattr(node, "_rtpu_parent", None)
+
+
+def _ancestors(node: ast.AST):
+    cur = _parent(node)
+    while cur is not None:
+        yield cur
+        cur = _parent(cur)
+
+
+def _dotted(node: ast.AST) -> str:
+    """``a.b.c`` for a Name/Attribute chain, "" for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _qualname(node: ast.AST) -> str:
+    names = []
+    cur: ast.AST | None = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            names.append(cur.name)
+        cur = _parent(cur)
+    return ".".join(reversed(names))
+
+
+@dataclass
+class Module:
+    """One parsed source file plus the derived tables the rules share."""
+
+    path: str             # absolute
+    relpath: str          # as reported in findings
+    src: str
+    tree: ast.AST = field(init=False)
+    lines: list[str] = field(init=False)
+    pragmas: dict = field(init=False)
+    #: bare name → module-scope (top-level or method) FunctionDefs
+    functions: dict = field(init=False)
+    #: RTPU_* env-var reads: (var, node) — feeds the project-level RT007
+    env_reads: list = field(init=False)
+
+    def __post_init__(self):
+        self.tree = ast.parse(self.src, filename=self.relpath)
+        _set_parents(self.tree)
+        self.lines = self.src.splitlines()
+        self.pragmas = parse_suppressions(self.lines)
+        self.functions = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, []).append(node)
+        self.env_reads = []
+        for node in ast.walk(self.tree):
+            var = _env_read_var(node)
+            if var is not None and _ENV_VAR_RE.match(var or ""):
+                self.env_reads.append((var, node))
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=rule, name=RULES[rule], path=self.relpath, line=line,
+            col=getattr(node, "col_offset", 0) + 1, message=message,
+            symbol=_qualname(node), line_text=self.line_text(line))
+
+
+# ---------------------------------------------------------------------------
+# shared detectors
+
+
+def _env_read_var(node: ast.AST):
+    """Return the env-var name for an ``os.environ``/``os.getenv`` read
+    (``""`` when the key is dynamic), or None when ``node`` is not one."""
+    if isinstance(node, ast.Call):
+        target = None
+        if isinstance(node.func, ast.Attribute):
+            base = _dotted(node.func.value)
+            if node.func.attr == "get" and base.endswith("environ"):
+                target = node.args[0] if node.args else None
+            elif node.func.attr == "getenv" and base in ("os", ""):
+                target = node.args[0] if node.args else None
+            else:
+                return None
+        else:
+            return None
+        if isinstance(target, ast.Constant) and isinstance(target.value, str):
+            return target.value
+        return ""
+    if isinstance(node, ast.Subscript):
+        if _dotted(node.value).endswith("environ"):
+            key = node.slice
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                return key.value
+            return ""
+    return None
+
+
+def _is_cached_def(node) -> bool:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = _dotted(target)
+        if name.split(".")[-1] in _CACHE_DECORATORS:
+            return True
+    return False
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    name = _dotted(call.func)
+    return name.split(".")[-1] in _JIT_NAMES
+
+
+def _jit_decorated(node) -> bool:
+    for dec in node.decorator_list:
+        if isinstance(dec, ast.Call):
+            if _is_jit_call(dec):
+                return True
+            # @partial(jax.jit, ...)
+            if (_dotted(dec.func).split(".")[-1] == "partial" and dec.args
+                    and isinstance(dec.args[0], (ast.Name, ast.Attribute))
+                    and _dotted(dec.args[0]).split(".")[-1] in _JIT_NAMES):
+                return True
+        elif _dotted(dec).split(".")[-1] in _JIT_NAMES:
+            return True
+    return False
+
+
+def _enclosing_def(node: ast.AST):
+    return next((a for a in _ancestors(node)
+                 if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))),
+                None)
+
+
+def _traced_defs(mod: Module) -> list:
+    """Function defs that become traced/compiled code: ``@jit``-decorated,
+    or passed by name as the first argument of a ``jax.jit(...)`` call.
+    Name lookup is scoped: ``jax.jit(run)`` inside a factory resolves to
+    the ``run`` defined in THAT factory, never a same-named method
+    elsewhere in the module."""
+    traced = []
+    seen = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _jit_decorated(node) and id(node) not in seen:
+                traced.append(node)
+                seen.add(id(node))
+        elif isinstance(node, ast.Call) and _is_jit_call(node) and node.args:
+            arg0 = node.args[0]
+            if isinstance(arg0, ast.Name):
+                scope = _enclosing_def(node)
+                for fn in mod.functions.get(arg0.id, []):
+                    if _enclosing_def(fn) is scope and id(fn) not in seen:
+                        traced.append(fn)
+                        seen.add(id(fn))
+    return traced
+
+
+def _calls_sleep(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name.split(".")[-1] == "sleep":
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# RT001 env-not-in-cache-key
+
+
+def _check_env_not_in_cache_key(mod: Module) -> list[Finding]:
+    """An env/config read reachable from an ``lru_cache``'d function: the
+    knob's value influences the cached result but is absent from the cache
+    key (the argument tuple), so flipping the env var mid-process silently
+    reuses programs built for the old value — the RTPU_TILE_BUDGET_MB bug."""
+    out = []
+    cached = [f for fns in mod.functions.values() for f in fns
+              if _is_cached_def(f)]
+    for root in cached:
+        # walk the cached function's subtree, following calls to other
+        # module-scope functions (the factory-helper idiom), bounded depth
+        seen = {id(root)}
+        frontier = [root]
+        depth = 0
+        while frontier and depth < 6:
+            nxt = []
+            for fn in frontier:
+                for node in ast.walk(fn):
+                    var = _env_read_var(node)
+                    if var is not None:
+                        label = var or "<dynamic>"
+                        out.append(mod.finding(
+                            "RT001", node,
+                            f"env knob {label!r} read inside code reachable "
+                            f"from lru_cache'd {root.name!r} — the knob is "
+                            f"not part of the cache key; pass it as an "
+                            f"argument instead"))
+                    if isinstance(node, ast.Call):
+                        callee = _dotted(node.func)
+                        for cand in mod.functions.get(
+                                callee.split(".")[-1], []):
+                            # only follow plain helpers, not other factories
+                            if id(cand) not in seen and callee and \
+                                    not _is_cached_def(cand):
+                                seen.add(id(cand))
+                                nxt.append(cand)
+            frontier = nxt
+            depth += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RT002 broad-except-retry
+
+
+def _check_broad_except_retry(mod: Module) -> list[Finding]:
+    """``except Exception`` inside a sleep/backoff loop whose handler never
+    re-raises: programming errors (bad shapes, real OOM) burn the full
+    backoff schedule (~70 s at the transfer defaults) before surfacing.
+    Classified handlers — ones that conditionally ``raise`` non-transient
+    errors, transfer-style — pass."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        types = []
+        if node.type is None:
+            types = [""]
+        elif isinstance(node.type, ast.Tuple):
+            types = [_dotted(e) for e in node.type.elts]
+        else:
+            types = [_dotted(node.type)]
+        if not any(t in ("", "Exception", "BaseException") for t in types):
+            continue
+        # a handler that raises (even conditionally), breaks, or returns is
+        # classifying or bailing out — not blindly retrying
+        if any(isinstance(n, (ast.Raise, ast.Break, ast.Return))
+               for body in node.body for n in ast.walk(body)):
+            continue
+        loop = next((a for a in _ancestors(node)
+                     if isinstance(a, (ast.For, ast.While))), None)
+        if loop is None or not _calls_sleep(loop):
+            continue
+        out.append(mod.finding(
+            "RT002", node,
+            "broad except inside a sleep/backoff loop hides programming "
+            "errors behind the full retry schedule — classify (re-raise "
+            "non-transient) like utils/transfer._is_transient"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RT003 host-sync-in-trace / RT005 nondeterminism-in-trace
+
+
+def _check_host_sync_in_trace(mod: Module) -> list[Finding]:
+    """Host-sync primitives inside traced function bodies: under ``jit``
+    these either fail at trace time or (worse) silently constant-fold a
+    tracer-dependent value at compile time."""
+    out = []
+    for fn in _traced_defs(mod):
+        params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = None
+            if isinstance(node.func, ast.Attribute):
+                base = _dotted(node.func.value)
+                if node.func.attr in ("item", "block_until_ready") and \
+                        not base.startswith(("np", "numpy")):
+                    msg = (f".{node.func.attr}() forces a device→host sync")
+                elif node.func.attr in ("asarray", "array") and \
+                        base in ("np", "numpy"):
+                    msg = (f"{base}.{node.func.attr}() materialises a tracer "
+                           f"on the host")
+                elif node.func.attr == "device_get":
+                    msg = "device_get() forces a device→host sync"
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in ("float", "int", "bool") and \
+                    len(node.args) == 1 and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in params:
+                msg = (f"{node.func.id}() on traced argument "
+                       f"{node.args[0].id!r} concretises a tracer")
+            if msg:
+                out.append(mod.finding(
+                    "RT003", node,
+                    f"{msg} inside jit-traced {fn.name!r} — hoist it out of "
+                    f"the traced body"))
+    return out
+
+
+def _check_nondeterminism_in_trace(mod: Module) -> list[Finding]:
+    """Wall-clock / unkeyed randomness inside traced bodies: the value is
+    frozen at trace time and silently replayed by every cached execution."""
+    out = []
+    for fn in _traced_defs(mod):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name in _NONDET_CALLS or name.startswith(_NONDET_PREFIXES):
+                out.append(mod.finding(
+                    "RT005", node,
+                    f"{name}() inside jit-traced {fn.name!r} is evaluated "
+                    f"once at trace time and baked into the compiled "
+                    f"program — thread the value in as an argument (or use "
+                    f"keyed jax.random)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RT004 use-after-donate
+
+
+def _donating_factories(mod: Module) -> dict:
+    """name → donated positional indices, for module functions that return
+    ``jax.jit(..., donate_argnums=...)`` — the repo's compiled-factory
+    idiom."""
+    out = {}
+    for name, fns in mod.functions.items():
+        for fn in fns:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and \
+                        isinstance(node.value, ast.Call) and \
+                        _is_jit_call(node.value):
+                    pos = _donated_positions(node.value)
+                    if pos:
+                        out[name] = pos
+    return out
+
+
+def _donated_positions(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                pos = {e.value for e in v.elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, int)}
+                if pos:
+                    return pos
+        elif kw.arg == "donate_argnames":
+            return set()   # names unsupported statically — still donating
+    return None
+
+
+def _check_use_after_donate(mod: Module) -> list[Finding]:
+    """Reading a variable after passing it at a donated position: XLA has
+    already reused its buffer, so the read returns garbage (TPU) or raises
+    a deleted-buffer error — either way, after an arbitrary delay."""
+    out = []
+    factories = _donating_factories(mod)
+    for fns in mod.functions.values():
+        for fn in fns:
+            # donating callables bound inside this function:
+            # f = jax.jit(..., donate_argnums=…)  |  f = _compiled_apply(…)
+            donors: dict[str, set] = {}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        isinstance(node.value, ast.Call):
+                    call = node.value
+                    pos = None
+                    if _is_jit_call(call):
+                        pos = _donated_positions(call)
+                    else:
+                        callee = _dotted(call.func).split(".")[-1]
+                        pos = factories.get(callee)
+                    if pos:
+                        donors[node.targets[0].id] = pos
+            if not donors:
+                continue
+            # name → sorted store linenos, for the staleness check
+            stores: dict[str, list[int]] = {}
+            loads: dict[str, list[ast.Name]] = {}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name):
+                    if isinstance(node.ctx, (ast.Store, ast.Del)):
+                        stores.setdefault(node.id, []).append(node.lineno)
+                    else:
+                        loads.setdefault(node.id, []).append(node)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in donors):
+                    continue
+                for idx in sorted(donors[node.func.id]):
+                    if idx >= len(node.args):
+                        continue
+                    arg = node.args[idx]
+                    if not isinstance(arg, ast.Name):
+                        continue   # *starred / attribute args: can't track
+                    for use in loads.get(arg.id, []):
+                        if use.lineno <= node.lineno or use is arg:
+                            continue
+                        # a store on the call line itself is the
+                        # ``x = f(x, …)`` rebind idiom — fresh value
+                        if any(node.lineno <= s <= use.lineno
+                               for s in stores.get(arg.id, [])):
+                            continue   # rebound in between — fresh value
+                        out.append(mod.finding(
+                            "RT004", use,
+                            f"{arg.id!r} is read after being donated to "
+                            f"{node.func.id!r} (arg {idx}) on line "
+                            f"{node.lineno} — its buffer may already be "
+                            f"reused; copy first or re-order"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RT006 unguarded-module-state
+
+
+def _module_mutables(mod: Module) -> set[str]:
+    names = set()
+    body = getattr(mod.tree, "body", [])
+    for stmt in body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        mutable = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp, ast.SetComp))
+        if isinstance(value, ast.Call):
+            mutable = _dotted(value.func).split(".")[-1] in _MUTABLE_FACTORIES
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+    return names
+
+
+def _under_lock(node: ast.AST) -> bool:
+    for anc in _ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                if "lock" in _dotted(expr).lower() or \
+                        "cond" in _dotted(expr).lower() or \
+                        "cv" in _dotted(expr).lower():
+                    return True
+    return False
+
+
+def _check_unguarded_module_state(mod: Module) -> list[Finding]:
+    """Module-level mutable containers mutated from function bodies with no
+    lock held: the ingest writer, transfer workers, and REST threads all
+    import the same modules, so an unguarded dict/list mutation is a data
+    race waiting for load."""
+    out = []
+    mutables = _module_mutables(mod)
+    if not mutables:
+        return out
+    for node in ast.walk(mod.tree):
+        fn = next((a for a in _ancestors(node)
+                   if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))),
+                  None)
+        if fn is None:
+            continue   # import-time mutation is single-threaded
+        name = None
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.attr in _MUTATOR_METHODS:
+            name = node.func.value.id
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgt = node.targets[0] if isinstance(node, ast.Assign) \
+                else node.target
+            if isinstance(tgt, ast.Subscript) and \
+                    isinstance(tgt.value, ast.Name):
+                name = tgt.value.id
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in mutables:
+                    name = t.value.id
+        if name not in mutables:
+            continue
+        # locals shadow the module name
+        local = any(isinstance(n, ast.Name) and n.id == name
+                    and isinstance(n.ctx, ast.Store)
+                    for n in ast.walk(fn)) and not any(
+            isinstance(n, ast.Global) and name in n.names
+            for n in ast.walk(fn))
+        if local:
+            continue
+        if _under_lock(node):
+            continue
+        out.append(mod.finding(
+            "RT006", node,
+            f"module-level mutable {name!r} mutated without a lock — "
+            f"threaded callers race; guard with a module lock or make the "
+            f"mutation import-time-only"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RT008 unused-import
+
+
+def _check_unused_import(mod: Module) -> list[Finding]:
+    """Imports never referenced: dead weight that still costs import time
+    and misleads readers about the module's dependencies."""
+    if os.path.basename(mod.relpath) == "__init__.py":
+        return []   # re-export surface — unused-by-design
+    bound = []   # (bound_name, node)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound.append((a.asname or a.name.split(".")[0], node))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                bound.append((a.asname or a.name, node))
+    if not bound:
+        return []
+    used = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Name) and not isinstance(node.ctx, ast.Store):
+            used.add(node.id)
+    # names exported via __all__ count as used
+    for node in getattr(mod.tree, "body", []):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "__all__"
+                for t in node.targets):
+            if isinstance(node.value, (ast.List, ast.Tuple)):
+                used.update(e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str))
+    out = []
+    for name, node in bound:
+        if name not in used and not name.startswith("_"):
+            out.append(mod.finding(
+                "RT008", node,
+                f"{name!r} is imported but never used"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RT007 undocumented-knob (project-level: needs the docs file)
+
+
+def check_undocumented_knobs(modules: list[Module], docs_text: str,
+                             docs_name: str) -> list[Finding]:
+    """Every ``RTPU_*`` env var read in code must appear in the operations
+    knob table — an undocumented knob is a support incident in waiting."""
+    out = []
+    reported = set()
+    for mod in modules:
+        for var, node in mod.env_reads:
+            if var in docs_text:
+                continue
+            if (mod.relpath, var) in reported:
+                continue
+            reported.add((mod.relpath, var))
+            out.append(mod.finding(
+                "RT007", node,
+                f"env knob {var!r} is read here but not documented in "
+                f"{docs_name} — add a row to the knob table"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# drivers
+
+_MODULE_CHECKS = [
+    _check_env_not_in_cache_key,
+    _check_broad_except_retry,
+    _check_host_sync_in_trace,
+    _check_use_after_donate,
+    _check_nondeterminism_in_trace,
+    _check_unguarded_module_state,
+    _check_unused_import,
+]
+
+
+def analyze_module(src: str, relpath: str = "<string>",
+                   path: str = "") -> list[Finding]:
+    """All per-module rules over one source text, suppressions applied."""
+    mod = Module(path=path or relpath, relpath=relpath, src=src)
+    findings: list[Finding] = []
+    for check in _MODULE_CHECKS:
+        findings.extend(check(mod))
+    return [f for f in findings if not suppressed(f, mod.pragmas)]
+
+
+def analyze_project(files: list[tuple[str, str]],
+                    docs_text: str = "",
+                    docs_name: str = "docs/OPERATIONS.md",
+                    rules: set[str] | None = None) -> list[Finding]:
+    """Run every rule over ``files`` ([(relpath, source)]), including the
+    cross-file knob audit. Unparseable files yield a single parse-error
+    finding rather than aborting the run."""
+    modules: list[Module] = []
+    findings: list[Finding] = []
+    for relpath, src in files:
+        try:
+            modules.append(Module(path=relpath, relpath=relpath, src=src))
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="RT000", name="parse-error", path=relpath,
+                line=e.lineno or 1, col=(e.offset or 0) + 1,
+                message=f"could not parse: {e.msg}"))
+    for mod in modules:
+        for check in _MODULE_CHECKS:
+            findings.extend(f for f in check(mod)
+                            if not suppressed(f, mod.pragmas))
+    knob_findings = check_undocumented_knobs(modules, docs_text, docs_name)
+    by_path = {m.relpath: m.pragmas for m in modules}
+    findings.extend(f for f in knob_findings
+                    if not suppressed(f, by_path.get(f.path, {})))
+    if rules:
+        # RT000 always survives filtering: a parse error is the only
+        # signal a file was never analyzed at all
+        findings = [f for f in findings
+                    if f.rule in rules or f.name in rules
+                    or f.rule == "RT000"]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,346 @@
+"""Runtime lock sanitizer — deadlock-order and device-boundary findings.
+
+``RTPU_SANITIZE=1`` (checked once, in ``raphtory_tpu/__init__``) wraps the
+``threading.Lock`` / ``threading.RLock`` factories so every lock created
+afterwards is tracked:
+
+* **lock-order-cycle** — each acquisition with other locks held adds
+  held→acquired edges to a process-wide lock-ordering graph; the first
+  edge that closes a cycle (A taken under B somewhere, B taken under A
+  elsewhere) is a potential deadlock and is reported ONCE per edge with
+  both creation sites and both acquisition stacks.
+* **lock-across-device-boundary** — ``jax.device_put`` / compiled-program
+  dispatch can block for seconds on a busy or flapping interconnect;
+  holding any sanitized lock across that boundary stalls every thread
+  queued on it (the ingest writer blocking REST reads is the motivating
+  shape). The sanitizer patches ``jax.device_put`` when jax is importable
+  and reports a held-lock set at each crossing.
+
+Findings go three ways: a ``logging`` warning, an in-process list
+(``findings()``, what tests assert on), and an ``obs.trace`` instant so
+the flight recorder timeline shows the hazard between the spans that
+caused it.
+
+Zero overhead when disabled: nothing is imported or patched unless
+``install()`` runs, and ``threading.Lock`` stays the pristine C factory.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import traceback
+
+_log = logging.getLogger("raphtory_tpu.analysis.sanitizer")
+
+#: pristine factories, captured at import so install/uninstall can swap
+_RAW_LOCK = threading.Lock
+_RAW_RLOCK = threading.RLock
+
+
+def _creation_site() -> str:
+    """file:line of the frame that called Lock()/RLock(), skipping this
+    module's own frames."""
+    for frame in reversed(traceback.extract_stack(limit=8)[:-2]):
+        if not frame.filename.endswith("sanitizer.py"):
+            return f"{os.path.basename(frame.filename)}:{frame.lineno}"
+    return "<unknown>"
+
+
+class _TrackedLock:
+    """Proxy over a raw lock that reports acquisition order to the
+    sanitizer. Supports the full Lock/RLock surface the codebase uses,
+    including being wrapped by ``threading.Condition``."""
+
+    def __init__(self, san: "LockSanitizer", raw, reentrant: bool):
+        self._san = san
+        self._raw = raw
+        self._reentrant = reentrant
+        self.site = _creation_site()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking:
+            # try-locks with fallback are a legitimate cycle-avoidance
+            # idiom — only blocking acquires add ordering edges
+            self._san._before_acquire(self)
+        got = self._raw.acquire(blocking, timeout)
+        if got:
+            self._san._note_acquired(self)
+        return got
+
+    def release(self):
+        self._san._note_released(self)
+        return self._raw.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        # threading.Condition(lock) probes _release_save/_acquire_restore/
+        # _is_owned with try/except AttributeError to distinguish RLock
+        # from Lock — delegation must preserve that (raising here when the
+        # RAW lock lacks the attr), while keeping the held-stack honest
+        # when Condition.wait releases/reacquires around the sleep
+        raw_attr = getattr(self._raw, name)   # AttributeError propagates
+        if name == "_release_save":
+            def _release_save():
+                self._san._note_released(self)
+                return raw_attr()
+            return _release_save
+        if name == "_acquire_restore":
+            def _acquire_restore(state):
+                raw_attr(state)
+                self._san._note_acquired(self)
+            return _acquire_restore
+        return raw_attr
+
+    def __repr__(self):
+        return f"<TrackedLock {self.site} over {self._raw!r}>"
+
+
+class LockSanitizer:
+    """Lock-ordering graph + device-boundary watcher.
+
+    One instance is installed process-wide via :func:`install`; tests build
+    private instances and call :meth:`install`/:meth:`uninstall` directly.
+    """
+
+    def __init__(self, tracer=None):
+        # bookkeeping must use the RAW factory: a tracked internal lock
+        # would recurse into its own sanitizer
+        self._mu = _RAW_LOCK()
+        self._local = threading.local()
+        #: site → set of sites acquired while this one was held
+        self._edges: dict[str, set] = {}
+        #: (from, to) edges already reported (report each hazard once)
+        self._reported: set = set()
+        self._findings: list[dict] = []
+        self._installed = False
+        self._jax_patched = False
+        self._tracer = tracer
+
+    # ---- install / uninstall ----
+
+    def install(self, patch_jax: bool = True) -> "LockSanitizer":
+        """Swap the ``threading`` factories for tracking wrappers. Locks
+        created BEFORE install stay untracked (import early)."""
+        if self._installed:
+            return self
+        self._installed = True
+        san = self
+
+        def make_lock():
+            return _TrackedLock(san, _RAW_LOCK(), reentrant=False)
+
+        def make_rlock():
+            return _TrackedLock(san, _RAW_RLOCK(), reentrant=True)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        if patch_jax:
+            self._patch_jax()
+        _log.info("lock sanitizer installed (RTPU_SANITIZE)")
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        threading.Lock = _RAW_LOCK
+        threading.RLock = _RAW_RLOCK
+        self._unpatch_jax()
+        self._installed = False
+
+    def _patch_jax(self) -> None:
+        try:
+            import jax
+        except Exception:
+            return   # stripped environment: lock-order checking still works
+        san = self
+        raw_put = jax.device_put
+
+        def checked_device_put(*args, **kwargs):
+            san.check_boundary("device_put")
+            return raw_put(*args, **kwargs)
+
+        self._raw_device_put = raw_put
+        jax.device_put = checked_device_put
+        self._jax_patched = True
+
+    def _unpatch_jax(self) -> None:
+        if self._jax_patched:
+            import jax
+
+            jax.device_put = self._raw_device_put
+            self._jax_patched = False
+
+    # ---- per-thread held stack ----
+
+    def _held(self) -> list:
+        st = getattr(self._local, "held", None)
+        if st is None:
+            st = self._local.held = []
+        return st
+
+    # ---- acquisition hooks ----
+
+    def _before_acquire(self, lock: _TrackedLock) -> None:
+        held = self._held()
+        if not held:
+            return
+        if lock._reentrant and any(h is lock for h in held):
+            return   # RLock re-entry adds no ordering constraint
+        for h in held:
+            if h is lock:
+                continue
+            self._add_edge(h, lock)
+
+    def _note_acquired(self, lock: _TrackedLock) -> None:
+        self._held().append(lock)
+
+    def _note_released(self, lock: _TrackedLock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    # ---- ordering graph ----
+
+    def _add_edge(self, frm: _TrackedLock, to: _TrackedLock) -> None:
+        a, b = frm.site, to.site
+        if a == b:
+            return   # two locks from one construction site (e.g. a pool)
+        with self._mu:
+            fresh = b not in self._edges.get(a, ())
+            if fresh:
+                self._edges.setdefault(a, set()).add(b)
+            cycle = self._find_path(b, a) if fresh else None
+        if cycle:
+            # path is b→…→a; the new a→b edge closes it — report each
+            # participating site once
+            self._report_cycle([a] + cycle[:-1])
+
+    def _find_path(self, start: str, goal: str):
+        """DFS path start→…→goal in the edge graph (caller holds _mu),
+        or None. A found path plus the new goal→start edge is a cycle."""
+        stack = [(start, [start])]
+        seen = {start}
+        while stack:
+            node, path = stack.pop()
+            if node == goal:
+                return path
+            for nxt in self._edges.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _report_cycle(self, sites: list[str]) -> None:
+        key = ("cycle", frozenset(sites))
+        with self._mu:
+            if key in self._reported:
+                return
+            self._reported.add(key)
+        finding = {
+            "kind": "lock-order-cycle",
+            "sites": sites,
+            "thread": threading.current_thread().name,
+            "stack": "".join(traceback.format_stack(limit=12)[:-3]),
+        }
+        self._emit(finding,
+                   "potential deadlock: lock-order cycle %s",
+                   " -> ".join(sites + [sites[0]]))
+
+    # ---- device boundary ----
+
+    def check_boundary(self, boundary: str) -> None:
+        """Report any sanitized locks the calling thread holds while
+        crossing ``boundary`` (device_put, compile, dispatch…). Public so
+        engine code can mark additional boundaries explicitly."""
+        held = [h.site for h in self._held()]
+        if not held:
+            return
+        key = (boundary, tuple(held))
+        with self._mu:
+            if key in self._reported:
+                return
+            self._reported.add(key)
+        finding = {
+            "kind": "lock-across-device-boundary",
+            "boundary": boundary,
+            "held": held,
+            "thread": threading.current_thread().name,
+            "stack": "".join(traceback.format_stack(limit=12)[:-3]),
+        }
+        self._emit(finding,
+                   "lock(s) %s held across %s — a slow interconnect stalls "
+                   "every thread queued on them", held, boundary)
+
+    # ---- reporting ----
+
+    def _emit(self, finding: dict, msg: str, *fmt) -> None:
+        with self._mu:
+            self._findings.append(finding)
+        _log.warning("sanitizer: " + msg, *fmt)
+        tracer = self._tracer
+        if tracer is None:
+            try:
+                from ..obs.trace import TRACER as tracer
+            except Exception:
+                tracer = False
+            self._tracer = tracer
+        if tracer:
+            attrs = {k: v for k, v in finding.items() if k != "stack"}
+            attrs["sites"] = ",".join(
+                finding.get("sites") or finding.get("held") or [])
+            tracer.instant("sanitizer." + finding["kind"], **attrs)
+
+    def findings(self, kind: str | None = None) -> list[dict]:
+        with self._mu:
+            out = list(self._findings)
+        if kind:
+            out = [f for f in out if f["kind"] == kind]
+        return out
+
+    def clear(self) -> None:
+        with self._mu:
+            self._findings.clear()
+            self._reported.clear()
+            self._edges.clear()
+
+
+#: the process-wide instance, set by install()
+_ACTIVE: LockSanitizer | None = None
+
+
+def install(patch_jax: bool = True) -> LockSanitizer:
+    """Install (or return) the process-wide sanitizer."""
+    global _ACTIVE
+    if _ACTIVE is None:
+        _ACTIVE = LockSanitizer()
+    _ACTIVE.install(patch_jax=patch_jax)
+    return _ACTIVE
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.uninstall()
+        _ACTIVE = None
+
+
+def active() -> LockSanitizer | None:
+    return _ACTIVE
+
+
+def maybe_install_from_env() -> LockSanitizer | None:
+    """The ``raphtory_tpu/__init__`` hook: one env read when disabled."""
+    if os.environ.get("RTPU_SANITIZE", "0") in ("", "0", "false"):
+        return None
+    return install()

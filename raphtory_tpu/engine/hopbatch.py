@@ -110,18 +110,26 @@ def _tile_budget_bytes() -> int:
     return int(os.environ.get("RTPU_TILE_BUDGET_MB", 256)) << 20
 
 
-def _edge_tile_for(m_pad: int, C: int, budget_bytes: int | None = None) -> int | None:
+def _edge_tile_for(m_pad: int, C: int, budget_bytes: int) -> int | None:
     """Edge-tile length for the columnar kernels, or None for single-shot.
 
     The per-iteration payload ``[m_pad, C] f32`` is the scale limiter: at
     28M pairs x 128 columns it is ~14 GB — over a v5e's HBM — and the
     resulting spill is catastrophic. When the payload would exceed
-    ``budget_bytes`` (default 256 MB; ``RTPU_TILE_BUDGET_MB`` overrides,
-    an on-device tuning knob), the edge dimension is processed as a
+    ``budget_bytes`` (``_tile_budget_bytes()``, resolved by every dispatch
+    site so the knob lands in the program cache key), the edge dimension
+    is processed as a
     ``lax.scan`` over equal tiles (plus one remainder slice, so no
     divisibility gymnastics) whose transient is ``tile * C * 4`` bytes."""
     if budget_bytes is None:
-        budget_bytes = _tile_budget_bytes()
+        # an env read here would happen at TRACE time, inside lru_cached
+        # factories whose key would not carry the knob (rtpulint RT001) —
+        # fail fast instead of silently caching programs tiled for a
+        # budget the env var no longer holds
+        raise ValueError(
+            "tile budget unresolved — dispatch sites must pass "
+            "_tile_budget_bytes() so RTPU_TILE_BUDGET_MB stays part of "
+            "the compiled-program cache key")
     if m_pad * C * 4 <= budget_bytes or m_pad <= (1 << 16):
         return None
     step = 1 << 16

@@ -17,7 +17,6 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..algorithms.rankings import DegreeRanking
-from ..engine.program import Context
 from ..ingestion.parser import Parser
 from ..ingestion.updates import EdgeAdd, VertexAdd, assign_id
 

@@ -11,7 +11,7 @@ like ``RouterWorker.assignID``'s MurmurHash3 (``RouterWorker.scala:75``).
 from __future__ import annotations
 
 import hashlib
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 def assign_id(key: str | int) -> int:

@@ -17,7 +17,7 @@ import time as _time
 import traceback
 from dataclasses import dataclass
 
-from ..core.service import StaleViewError, TemporalGraph
+from ..core.service import TemporalGraph
 from ..engine import bsp
 from ..engine.program import VertexProgram
 from ..obs.metrics import METRICS

@@ -9,31 +9,38 @@ analyser" = a Python snippet defining ``program``), no compiler machinery.
 
 from __future__ import annotations
 
+import threading
+
 from ..engine.program import VertexProgram
 
 _REGISTRY: dict[str, type] = {}
-_BUILTINS_LOADED = False
+_REGISTRY_LOCK = threading.Lock()   # REST threads register dynamic
+_BUILTINS_LOADED = False            # analysers while jobs resolve built-ins
 
 
 def register(name: str | None = None):
     def deco(cls):
-        _REGISTRY[name or cls.__name__] = cls
+        with _REGISTRY_LOCK:
+            _REGISTRY[name or cls.__name__] = cls
         return cls
     return deco
 
 
 def names() -> list[str]:
     _ensure_builtins()
-    return sorted(_REGISTRY)
+    with _REGISTRY_LOCK:
+        return sorted(_REGISTRY)
 
 
 def resolve(name: str, params: dict | None = None) -> VertexProgram:
     """Instantiate a registered program by name with hyperparams."""
     _ensure_builtins()
-    cls = _REGISTRY.get(name)
+    with _REGISTRY_LOCK:
+        cls = _REGISTRY.get(name)
+        known = sorted(_REGISTRY)
     if cls is None:
         raise KeyError(
-            f"unknown analyser {name!r}; registered: {sorted(_REGISTRY)}")
+            f"unknown analyser {name!r}; registered: {known}")
     return cls(**(params or {}))
 
 
@@ -53,10 +60,13 @@ def compile_source(source: str) -> VertexProgram:
 
 def _ensure_builtins() -> None:
     global _BUILTINS_LOADED
-    if _BUILTINS_LOADED:
+    if _BUILTINS_LOADED:   # benign racy fast-path; the slow path locks
         return
-    from .. import algorithms as A
+    from .. import algorithms as A   # import OUTSIDE the lock: an import
 
-    for nm in A.__all__:
-        _REGISTRY.setdefault(nm, getattr(A, nm))
-    _BUILTINS_LOADED = True
+    with _REGISTRY_LOCK:             # that re-enters the registry (the
+        if _BUILTINS_LOADED:         # @register decorators) must not
+            return                   # deadlock against it
+        for nm in A.__all__:
+            _REGISTRY.setdefault(nm, getattr(A, nm))
+        _BUILTINS_LOADED = True

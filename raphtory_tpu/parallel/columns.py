@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.hopbatch import (_bfs_columns, _cc_columns, _column_layout,
-                               _column_masks, _pagerank_columns, _seed_mask)
+                               _column_masks, _pagerank_columns, _seed_mask,
+                               _tile_budget_bytes)
 
 C_AXIS = "columns"
 
@@ -67,20 +68,27 @@ def run_columns_sharded(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
             extra_host.append(weight_cols)
             extra_specs.append(P())
 
+    # resolved HERE, outside the traced block — an env read at trace time
+    # would bake a budget the cache key doesn't carry (rtpulint RT001)
+    tile_budget = _tile_budget_bytes()
+
     def block(e_src, e_dst, el, ea, vl, va, hoc, tc, wc, *extra):
         me, mv = _column_masks(tdt, el, ea, vl, va, hoc, tc, wc)
         if kind == "pagerank":
             out, steps = _pagerank_columns(me, mv, e_src, e_dst, n_pad,
                                            float(damping), float(tol),
-                                           int(max_steps))
+                                           int(max_steps),
+                                           tile_budget=tile_budget)
         elif kind == "cc":
             out, steps = _cc_columns(me, mv, e_src, e_dst, n_pad,
-                                     int(max_steps))
+                                     int(max_steps),
+                                     tile_budget=tile_budget)
         elif kind == "bfs":
             ew = extra[1][hoc].T if len(extra) > 1 else 1.0
             out, steps = _bfs_columns(me, mv, e_src, e_dst, n_pad,
                                       int(max_steps), bool(directed),
-                                      extra[0], ew)
+                                      extra[0], ew,
+                                      tile_budget=tile_budget)
         else:
             raise ValueError(f"unknown columnar kind {kind!r}")
         return out, steps[None]   # scalar -> [1] so steps concatenates

@@ -22,7 +22,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..core.events import EventLog
-from ..core.snapshot import INT64_MIN, GraphView
+from ..core.snapshot import INT64_MIN
 from ..core.sweep import _ENC_MASK, _ENC_SHIFT, SweepBuilder
 from ..engine.device_sweep import GlobalTables, supported
 from . import sharded

@@ -11,7 +11,7 @@ deployments keep the env-var ergonomics.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field, fields
+from dataclasses import dataclass, fields
 
 
 def _env_bool(name: str, default: bool) -> bool:
