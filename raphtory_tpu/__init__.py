@@ -24,6 +24,13 @@ if _os.environ.get("RTPU_SANITIZE", "0") not in ("", "0", "false"):
 
     _mi()
 
+# RTPU_COMPILE_CACHE_DIR wires JAX's persistent compilation cache before
+# the first compile, so short TPU tunnel windows don't re-pay compilation.
+if _os.environ.get("RTPU_COMPILE_CACHE_DIR", ""):
+    from .utils.config import configure_compile_cache as _ccc
+
+    _ccc()
+
 from .core.events import EventLog
 from .core.snapshot import GraphView, build_view
 from .engine import bsp

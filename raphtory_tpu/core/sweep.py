@@ -27,6 +27,9 @@ Each ``view_at(T)`` emits a ``GraphView`` bit-identical to
 
 from __future__ import annotations
 
+import os
+import threading
+
 import numpy as np
 
 from .events import EDGE_ADD, EDGE_DELETE, VERTEX_ADD, VERTEX_DELETE, EventLog
@@ -42,19 +45,64 @@ from .snapshot import (
 _ENC_SHIFT = 32
 _ENC_MASK = (1 << _ENC_SHIFT) - 1
 
-_VFOLD_POOL = None
+
+def fold_workers() -> int:
+    """Size of the chunk-fold worker pool (``RTPU_FOLD_WORKERS``). The
+    default scales with the host — half the cores, capped at 8 — because
+    fold workers compete with the XLA CPU backend for the same cores;
+    ``1`` degrades every parallel-fold path to the serial pipeline."""
+    v = os.environ.get("RTPU_FOLD_WORKERS")
+    if v is not None:
+        return max(1, int(v))
+    return max(1, min(8, (os.cpu_count() or 2) // 2 + 1))
+
+
+_VFOLD_POOLS: dict = {}
+_VFOLD_POOLS_LOCK = threading.Lock()
 
 
 def _vfold_pool():
     """Process-wide worker pool for the overlapped vertex folds — shared
-    so long-lived servers don't pin one idle thread per SweepBuilder."""
-    global _VFOLD_POOL
-    if _VFOLD_POOL is None:
-        from concurrent.futures import ThreadPoolExecutor
+    so long-lived servers don't pin one idle thread per SweepBuilder.
+    Sized alongside the fold pool AND re-keyed when the knob changes
+    (like ``fold_pool``): every concurrent chunk fold blocks on one inner
+    vertex fold, so fewer workers than chunk folders would serialise the
+    overlap the split exists for."""
+    from concurrent.futures import ThreadPoolExecutor
 
-        _VFOLD_POOL = ThreadPoolExecutor(
-            max_workers=2, thread_name_prefix="sweep-vfold")
-    return _VFOLD_POOL
+    n = max(2, fold_workers())
+    with _VFOLD_POOLS_LOCK:
+        pool = _VFOLD_POOLS.get(n)
+        if pool is None:
+            pool = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="sweep-vfold")
+            _VFOLD_POOLS[n] = pool
+    return pool
+
+
+_FOLD_POOLS: dict = {}
+_FOLD_POOLS_LOCK = threading.Lock()
+
+
+def fold_pool():
+    """Process-wide sized pool for INDEPENDENT chunk folds (each task owns
+    a forked ``SweepBuilder`` — nothing shared, unlike the single-worker
+    prefetch lane). Keyed by the resolved ``RTPU_FOLD_WORKERS`` so tests
+    (and operators) that change the knob get a correctly-sized pool
+    instead of a stale cached one. Deliberately separate from
+    ``_vfold_pool``: a chunk fold BLOCKS on its inner vertex fold, and
+    sharing a pool would let it occupy the very worker that inner task
+    needs (the nested-submit deadlock ``_prefetch_pool`` documents)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    n = fold_workers()
+    with _FOLD_POOLS_LOCK:
+        pool = _FOLD_POOLS.get(n)
+        if pool is None:
+            pool = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="sweep-fold")
+            _FOLD_POOLS[n] = pool
+    return pool
 
 
 _PREFETCH_POOL = None
@@ -79,38 +127,93 @@ def _prefetch_pool():
     return _PREFETCH_POOL
 
 
-def prefetch_map(fold_fns, body) -> None:
-    """Drive ``fold_fns`` (zero-arg callables) through the prefetch worker
-    with one-deep lookahead, calling ``body(payload, stall_seconds)`` for
-    each fold's result while the NEXT fold already runs in the worker —
-    the body (ship + device dispatch) overlaps the following fold.
+def prefetch_depth() -> int:
+    """Lookahead depth of ``prefetch_map`` (``RTPU_PREFETCH_DEPTH``,
+    default 2): how many folds may be queued/in flight ahead of the fold
+    the dispatch loop is consuming, so several folds hide behind one long
+    device dispatch. On the single prefetch worker depth only QUEUES work
+    (folds still run one at a time, in order — safe for folds that share
+    a builder); on the sized ``fold_pool`` it is true concurrency."""
+    return max(1, int(os.environ.get("RTPU_PREFETCH_DEPTH", 2)))
+
+
+def prefetch_map(fold_fns, body, *, depth: int | None = None,
+                 pool=None) -> None:
+    """Drive ``fold_fns`` (zero-arg callables) through a fold worker pool
+    with ``depth``-deep lookahead, calling ``body(payload, stall_seconds)``
+    for each fold's result while the NEXT folds already run/queue in the
+    pool — the body (ship + device dispatch) overlaps the following folds.
     ``stall_seconds`` is how long the driver actually WAITED on the fold
-    (0 = it hid entirely behind the previous body). If a fold or a body
-    raises, the in-flight fold is drained SYNCHRONOUSLY before the
-    exception propagates — folds mutate shared sweep state, and the
+    (0 = it hid entirely behind the previous body). ``depth`` defaults to
+    ``prefetch_depth()``; ``pool`` defaults to the SINGLE-worker prefetch
+    lane, which serialises execution in submission order — the only safe
+    pool for folds that mutate one shared SweepBuilder. Pass
+    ``fold_pool()`` only for INDEPENDENT folds (forked builders). If a
+    fold or a body raises, every in-flight fold is drained SYNCHRONOUSLY
+    before the exception propagates — folds mutate sweep state, and the
     caller's error handler must not reset that state under a
     still-running fold. The single concurrency-pattern copy both sweep
     engines pipeline through (a generator can't give this guarantee: its
     finally would only drain at finalisation, which the propagating
     traceback's frame references delay past the caller's handler)."""
+    import collections
     import time as _t
 
     fns = list(fold_fns)
     if not fns:
         return
-    pool = _prefetch_pool()
-    fut = pool.submit(fns[0])
+    if depth is None:
+        depth = prefetch_depth()
+    depth = max(1, depth)
+    if pool is None:
+        pool = _prefetch_pool()
+    inflight = collections.deque(
+        pool.submit(fns[i]) for i in range(min(depth, len(fns))))
+    nxt = len(inflight)
     try:
-        for i in range(len(fns)):
+        for _ in range(len(fns)):
+            fut = inflight.popleft()
             t0 = _t.perf_counter()
             payload = fut.result()
             stall = _t.perf_counter() - t0
-            fut = pool.submit(fns[i + 1]) if i + 1 < len(fns) else None
+            if nxt < len(fns):
+                inflight.append(pool.submit(fns[nxt]))
+                nxt += 1
             body(payload, stall)
     except BaseException:
-        if fut is not None:   # let the in-flight fold finish first
+        for fut in inflight:   # let every in-flight fold finish first
             fut.exception()
         raise
+
+#: SweepBuilder attributes that are pure functions of the pinned log —
+#: forks SHARE them (never mutated after __init__)
+_LOG_DERIVED = ("log", "include_occurrences", "pad", "track_rows",
+                "_t", "_k", "_s", "_d", "uv", "_ok", "_sd_all", "_dd_all",
+                "_t_sorted", "_preseeded")
+#: fold-state arrays mutated IN PLACE by _advance — checkpoint/fork copy
+_STATE_COPIED = ("v_lat", "v_alive", "v_first", "v_seen",
+                 "e_lat", "e_alive", "e_first", "e_seen")
+#: fold-state arrays only ever REBOUND by _advance (np.insert/concatenate
+#: build fresh arrays) — a checkpoint can hold the reference
+_STATE_SHARED = ("e_enc", "e_enc_dst", "dh_v", "dh_t",
+                 "_ea_rows", "_va_rows")
+
+
+class FoldCheckpoint:
+    """Immutable snapshot of a ``SweepBuilder``'s fold state at ``t_prev``
+    — the seed of ``SweepBuilder.fork``. Checkpoints from ANY builder over
+    the same pinned log content are interchangeable (the dense spaces are
+    content-determined), which is what lets the fold cache hand them
+    across requests; ``config`` guards against mixing builders with
+    different emit/preseed settings."""
+
+    __slots__ = ("t_prev", "state", "config", "nbytes")
+
+    def __init__(self, t_prev, state: dict, config: tuple):
+        self.t_prev = t_prev
+        self.state = state
+        self.config = config
+        self.nbytes = int(sum(a.nbytes for a in state.values()))
 
 _EMPTY_DELTA = {
     "v_idx": np.empty(0, np.int64), "v_lat": np.empty(0, np.int64),
@@ -238,6 +341,53 @@ class SweepBuilder:
         if flip:
             enc = ((enc & _ENC_MASK) << _ENC_SHIFT) | (enc >> _ENC_SHIFT)
         return enc, dt[qidx]
+
+    # ---- checkpoint / fork ----
+
+    def _config(self) -> tuple:
+        return (self.include_occurrences, self.pad, self.track_rows,
+                self._preseeded, len(self.uv), len(self._t))
+
+    def checkpoint(self) -> FoldCheckpoint:
+        """Snapshot the fold state at the current ``t_prev``. Arrays that
+        ``_advance`` mutates in place are copied; arrays it only ever
+        rebinds (the sorted pair/dst tables, delete history, row lists)
+        are shared by reference — a later advance builds fresh ones and
+        never touches the snapshot's."""
+        state = {k: getattr(self, k).copy() for k in _STATE_COPIED}
+        state.update({k: getattr(self, k) for k in _STATE_SHARED})
+        return FoldCheckpoint(self.t_prev, state, self._config())
+
+    def fork(self, cp: FoldCheckpoint | None = None) -> "SweepBuilder":
+        """An INDEPENDENT builder over the same pinned log, seeded from
+        ``cp`` (or this builder's current state): log-derived arrays are
+        shared (immutable after __init__), fold state is copied — the
+        fork and the original advance without observing each other. This
+        is how a range sweep's chunks fold concurrently: each chunk forks
+        from the nearest checkpoint and folds its own hop window.
+        Equivalence holds because the fold state at T is a function of
+        (log, T) alone, not of the hop sequence that reached it (the
+        ``view_at ≡ build_view`` contract, tested per hop batching)."""
+        if cp is not None and cp.config != self._config():
+            raise ValueError(
+                "checkpoint was taken from an incompatible SweepBuilder "
+                f"(config {cp.config} != {self._config()}) — fold "
+                "checkpoints only transfer between builders over the same "
+                "pinned log content and emit settings")
+        sw = SweepBuilder.__new__(SweepBuilder)
+        for k in _LOG_DERIVED:
+            setattr(sw, k, getattr(self, k))
+        src = cp.state if cp is not None else None
+        for k in _STATE_COPIED:
+            setattr(sw, k, (src[k] if src is not None
+                            else getattr(self, k)).copy())
+        for k in _STATE_SHARED:
+            # rebind-only arrays: the fork's first rebind leaves the
+            # source (live builder or cached checkpoint) untouched
+            setattr(sw, k, src[k] if src is not None else getattr(self, k))
+        sw.t_prev = cp.t_prev if cp is not None else self.t_prev
+        sw.last_delta = None
+        return sw
 
     # ---- the sweep ----
 
@@ -473,3 +623,237 @@ class SweepBuilder:
             ae_s, ae_d, ae_latest, ae_first, self.pad,
             eadd_rows, vadd_rows, occ, locs,
         )
+
+
+# ------------------------------------------------------------- fold cache
+
+_METRICS_SENTINEL = object()
+_METRICS = _METRICS_SENTINEL
+
+
+def _metrics():
+    """obs.metrics bundle, or None when prometheus isn't importable —
+    core must keep working in stripped environments."""
+    global _METRICS
+    if _METRICS is _METRICS_SENTINEL:
+        try:
+            from ..obs.metrics import METRICS
+
+            _METRICS = METRICS
+        except Exception:
+            _METRICS = None
+    return _METRICS
+
+
+def _tracer():
+    try:
+        from ..obs.trace import TRACER
+
+        return TRACER
+    except Exception:
+        return None
+
+
+def log_fingerprint(log) -> tuple:
+    """Content identity of a pinned log for fold-cache keys: row count +
+    order-sensitive checksums over every column, plus the append version.
+    Cached on the (frozen, immutable) pin — repeated REST requests pin
+    the same live log and must land on the same key, and two logs that
+    merely share a version counter must not collide."""
+    fp = getattr(log, "_rtpu_fold_fp", None)
+    if fp is not None:
+        return fp
+    t = log.column("time")
+    idx = np.arange(len(t), dtype=np.uint64)
+    gold = np.uint64(0x9E3779B97F4A7C15)
+
+    def mix(a):
+        if not len(a):
+            return 0
+        h = a.astype(np.int64, copy=False).view(np.uint64)
+        return int(np.bitwise_xor.reduce((h + gold) * (idx * gold + gold)))
+
+    # src and dst stay SEPARATE components: xor-combining them would be
+    # symmetric per row, colliding a graph with its (partial) transpose
+    fp = (int(len(t)), int(log.version), mix(t),
+          mix(log.column("src")), mix(log.column("dst")),
+          mix(log.column("kind").astype(np.int64)))
+    try:
+        log._rtpu_fold_fp = fp   # pins are frozen: content never changes
+    except AttributeError:
+        pass
+    return fp
+
+
+class FoldCache:
+    """Bounded, memory-accounted, cross-request fold cache (LRU).
+
+    Two kinds of entries share one byte budget:
+
+    * **payloads** — a columnar engine's complete fold output for an
+      exact (log fingerprint, hop grid) — a repeated REST range job skips
+      folding entirely (``engine/hopbatch`` integration);
+    * **checkpoints** — ``FoldCheckpoint`` states at chunk boundaries,
+      so a later sweep over the same log seeds its chunk forks from the
+      NEAREST checkpoint instead of re-folding the prefix.
+
+    All mutation is under one lock; values must be treated as immutable
+    by callers (payload arrays are never written after insertion — the
+    engines copy-on-ship by construction)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        from collections import OrderedDict
+
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        # (fp, config) -> ascending checkpoint times, for nearest lookup
+        self._ckpt_times: dict[tuple, list] = {}
+
+    # -- internals (callers hold self._lock) --
+
+    def _evict_until(self, budget: int) -> None:
+        while self._bytes > budget and self._entries:
+            key, (value, nbytes) = self._entries.popitem(last=False)
+            self._bytes -= nbytes
+            self.evictions += 1
+            if key[0] == "ckpt":
+                times = self._ckpt_times.get(key[1:3])
+                if times is not None:
+                    try:
+                        times.remove(key[3])
+                    except ValueError:
+                        pass
+            m = _metrics()
+            if m is not None:
+                m.fold_cache_evictions.inc()
+                m.fold_cache_bytes.set(self._bytes)
+
+    def _note(self, hit: bool, key: tuple, nbytes: int = 0) -> None:
+        m = _metrics()
+        if m is not None:
+            (m.fold_cache_hits if hit else m.fold_cache_misses).inc()
+        tr = _tracer()
+        if tr is not None:
+            tr.instant("fold.cache", hit=hit, kind=str(key[0]),
+                       bytes=int(nbytes), cached_bytes=self._bytes)
+
+    # -- payload entries --
+
+    def get(self, key: tuple):
+        """Cached value for ``key`` (LRU-touch) or None — counts a hit or
+        a miss either way."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self.misses += 1
+                self._note(False, key)
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            self._note(True, key, ent[1])
+            return ent[0]
+
+    def put(self, key: tuple, value, nbytes: int) -> bool:
+        """Insert (or refresh) ``key``; evicts LRU entries past the byte
+        bound. Values larger than the whole bound are refused (False) —
+        one oversized sweep must not flush every other tenant."""
+        nbytes = int(nbytes)
+        if nbytes > self.max_bytes:
+            return False
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (value, nbytes)
+            self._bytes += nbytes
+            self._evict_until(self.max_bytes)
+            m = _metrics()
+            if m is not None:
+                m.fold_cache_bytes.set(self._bytes)
+        return True
+
+    # -- checkpoint entries --
+
+    def put_checkpoint(self, fp: tuple, cp: FoldCheckpoint) -> bool:
+        if cp.t_prev is None or cp.nbytes > self.max_bytes:
+            return False
+        key = ("ckpt", fp, cp.config, int(cp.t_prev))
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return True
+            self._entries[key] = (cp, cp.nbytes)
+            self._bytes += cp.nbytes
+            times = self._ckpt_times.setdefault((fp, cp.config), [])
+            import bisect
+
+            bisect.insort(times, int(cp.t_prev))
+            self._evict_until(self.max_bytes)
+            m = _metrics()
+            if m is not None:
+                m.fold_cache_bytes.set(self._bytes)
+        return True
+
+    def nearest_checkpoint(self, fp: tuple, config: tuple,
+                           time: int) -> FoldCheckpoint | None:
+        """Latest cached checkpoint at or before ``time`` for this log —
+        the fork seed that minimises the prefix re-fold."""
+        import bisect
+
+        with self._lock:
+            times = self._ckpt_times.get((fp, config))
+            if not times:
+                self.misses += 1
+                self._note(False, ("ckpt", fp))
+                return None
+            i = bisect.bisect_right(times, int(time))
+            if i == 0:
+                self.misses += 1
+                self._note(False, ("ckpt", fp))
+                return None
+            key = ("ckpt", fp, config, times[i - 1])
+            ent = self._entries.get(key)
+            if ent is None:   # index raced an eviction
+                self.misses += 1
+                self._note(False, key)
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            self._note(True, key, ent[1])
+            return ent[0]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "max_bytes": self.max_bytes, "hits": self.hits,
+                    "misses": self.misses, "evictions": self.evictions}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._ckpt_times.clear()
+            self._bytes = 0
+
+
+_FOLD_CACHE = None
+_FOLD_CACHE_LOCK = threading.Lock()
+
+
+def fold_cache() -> FoldCache | None:
+    """Process-wide fold cache, sized by ``RTPU_FOLD_CACHE_MB`` (default
+    256; ``0`` disables). The bound is re-read per call so tests and
+    operators can resize/disable without a restart — a size change swaps
+    in a fresh cache (the old one drains by GC)."""
+    global _FOLD_CACHE
+    mb = int(os.environ.get("RTPU_FOLD_CACHE_MB", 256))
+    if mb <= 0:
+        return None
+    with _FOLD_CACHE_LOCK:
+        if _FOLD_CACHE is None or _FOLD_CACHE.max_bytes != mb << 20:
+            _FOLD_CACHE = FoldCache(mb << 20)
+        return _FOLD_CACHE

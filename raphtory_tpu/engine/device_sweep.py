@@ -382,38 +382,44 @@ class DeviceSweep:
             return payload
         if not advanced:   # repeat hop on healthy buffers: nothing to ship
             return {"time": time, "kind": "noop"}
-        d = self.sw.last_delta
-        nv, ne = len(d["v_idx"]), len(d["e_enc"])
-        if nv == 0 and ne == 0:
-            self.fold_seconds += _time.perf_counter() - f0
-            return {"time": time, "kind": "noop"}
-        # full-state refresh (first hop, or a delta so large that chunked
-        # scatters would ship more than the whole buffers): host-assemble and
-        # device_put — one transfer, no scatter program involved
-        if nv > self.n_pad // 2 or ne > self.m_pad // 2:
-            payload = {"time": time, "kind": "full",
-                       "arrays": self._stage_full()}
-        else:
-            e_pos = self.tables.eng_pos(d["e_enc"])
-            n_chunks = max(-(-nv // self.cap_v), -(-ne // self.cap_e), 1)
-            chunks = []
-            for i in range(n_chunks):
-                ov, oe = i * self.cap_v, i * self.cap_e
-                # out-of-range slices are empty; pad rows scatter out of
-                # bounds and are dropped
-                chunks.append(self._stage_chunk(
-                    d["v_idx"][ov: ov + self.cap_v],
-                    d["v_lat"][ov: ov + self.cap_v],
-                    d["v_alive"][ov: ov + self.cap_v],
-                    d["v_first"][ov: ov + self.cap_v],
-                    e_pos[oe: oe + self.cap_e],
-                    d["e_lat"][oe: oe + self.cap_e],
-                    d["e_alive"][oe: oe + self.cap_e],
-                    d["e_first"][oe: oe + self.cap_e],
-                ))
-            payload = {"time": time, "kind": "chunks", "chunks": chunks}
+        payload = self._stage_payload(self.sw, time)
         self.fold_seconds += _time.perf_counter() - f0
         return payload
+
+    def _stage_payload(self, sw, time: int) -> dict:
+        """Staged payload for ``sw``'s LAST advance (to ``time``): noop /
+        full-refresh / padded delta chunks. The ONE copy of the staging
+        policy — the engine-clock fold (``_fold_hop_inner``) and the
+        forked parallel fold (``_fold_hop_fork``) both stage through it,
+        so the two paths can never diverge."""
+        d = sw.last_delta
+        nv, ne = len(d["v_idx"]), len(d["e_enc"])
+        if nv == 0 and ne == 0:
+            return {"time": time, "kind": "noop"}
+        # full-state refresh (first hop, or a delta so large that chunked
+        # scatters would ship more than the whole buffers): host-assemble
+        # and device_put — one transfer, no scatter program involved
+        if nv > self.n_pad // 2 or ne > self.m_pad // 2:
+            return {"time": time, "kind": "full",
+                    "arrays": self._stage_full(sw)}
+        e_pos = self.tables.eng_pos(d["e_enc"])
+        n_chunks = max(-(-nv // self.cap_v), -(-ne // self.cap_e), 1)
+        chunks = []
+        for i in range(n_chunks):
+            ov, oe = i * self.cap_v, i * self.cap_e
+            # out-of-range slices are empty; pad rows scatter out of
+            # bounds and are dropped
+            chunks.append(self._stage_chunk(
+                d["v_idx"][ov: ov + self.cap_v],
+                d["v_lat"][ov: ov + self.cap_v],
+                d["v_alive"][ov: ov + self.cap_v],
+                d["v_first"][ov: ov + self.cap_v],
+                e_pos[oe: oe + self.cap_e],
+                d["e_lat"][oe: oe + self.cap_e],
+                d["e_alive"][oe: oe + self.cap_e],
+                d["e_first"][oe: oe + self.cap_e],
+            ))
+        return {"time": time, "kind": "chunks", "chunks": chunks}
 
     def _apply_staged(self, payload: dict) -> None:
         """Device half of one hop: ship the staged arrays and scatter them
@@ -487,8 +493,8 @@ class DeviceSweep:
                                 v_idx, v_lat, v_alive, v_first,
                                 e_idx, e_lat, e_alive, e_first)]})
 
-    def _stage_full(self) -> tuple:
-        sw = self.sw
+    def _stage_full(self, sw=None) -> tuple:
+        sw = self.sw if sw is None else sw
         tdt = self.tdtype
         v_lat = np.full(self.n_pad, self._tmin, tdt)
         v_alive = np.zeros(self.n_pad, bool)
@@ -607,7 +613,14 @@ class DeviceSweep:
             return results, steps
         import functools as _ft
 
-        from ..core.sweep import prefetch_map
+        from ..core.sweep import fold_workers, prefetch_map
+
+        if fold_workers() > 1 and not self._stale and len(times) >= 2:
+            # segment-parallel host folds on forked builders (the sized
+            # RTPU_FOLD_WORKERS pool); RTPU_FOLD_WORKERS=1 keeps the
+            # single-worker shared-builder pipeline below
+            return self._run_sweep_parallel(program, times, window,
+                                            windows, results, steps)
 
         def step(payload, stall):
             self.fold_stall_seconds += stall
@@ -631,3 +644,101 @@ class DeviceSweep:
             self._stale = True
             raise
         return results, steps
+
+    def _run_sweep_parallel(self, program, times, window, windows,
+                            results, steps):
+        """Segment-parallel sweep folds: the hop list splits into up to
+        ``fold_workers()`` contiguous segments, each folded + staged on an
+        INDEPENDENT fork of the sweep's builder (seeded by one bulk
+        advance to the previous segment's boundary) on the sized fold
+        pool, while earlier hops ship and compute on this thread. The
+        per-hop payloads are identical to the serial fold's (delta
+        windows per hop are unchanged), so applied state and results are
+        bit-identical. The engine adopts the last segment's builder at
+        the end — the host fold clock lands exactly where the serial
+        sweep leaves it."""
+        from ..core.sweep import fold_pool, fold_workers, prefetch_map
+
+        if self.t_now is not None and times[0] < self.t_now:
+            raise ValueError(
+                f"DeviceSweep times must ascend "
+                f"(got {times[0]} < {self.t_now})")
+        n_seg = min(fold_workers(), len(times))
+        per = -(-len(times) // n_seg)
+        segs = [times[s * per:(s + 1) * per] for s in range(n_seg)]
+        segs = [s for s in segs if s]
+
+        def make_task(i: int):
+            boundary = int(segs[i - 1][-1]) if i > 0 else None
+
+            def task():
+                f0 = _time.perf_counter()
+                payloads = []
+                with TRACER.span("hop.fold", hops=len(segs[i]),
+                                    engine="device_sweep",
+                                    mode="parallel"):
+                    sw = self.sw.fork()
+                    prev = sw.t_prev
+                    if boundary is not None and (prev is None
+                                                 or prev < boundary):
+                        with TRACER.span("fold.checkpoint",
+                                            time=boundary):
+                            sw._advance(boundary)
+                        prev = boundary
+                    for T in segs[i]:
+                        payloads.append(self._fold_hop_fork(sw, T, prev))
+                        prev = int(T)
+                return sw, payloads, _time.perf_counter() - f0
+            return task
+
+        last_sw = [self.sw]
+
+        def consume(res, stall):
+            sw, payloads, dt = res
+            self.fold_seconds += dt
+            self.fold_stall_seconds += stall
+            if stall > 0:
+                TRACER.complete("fold.stall", stall)
+            m = _metrics()
+            if m is not None:
+                m.h2d_stall_seconds.labels(stage="fold").inc(stall)
+                m.fold_seconds.labels("parallel").observe(dt)
+            last_sw[0] = sw
+            for payload in payloads:
+                # t_now and self.sw only move TOGETHER at adoption below —
+                # a mid-sweep failure must leave clock == host fold so the
+                # stale full-refresh restages a state that covers it
+                self._apply_staged(payload)
+                r, s = self._dispatch(program, payload["time"], window,
+                                      windows)
+                results.append(r)
+                steps.append(s)
+
+        try:
+            prefetch_map([make_task(i) for i in range(len(segs))], consume,
+                         depth=len(segs), pool=fold_pool())
+        except BaseException:
+            # some forked fold/staged payload may be ahead of the applied
+            # buffers — recover through the full-refresh path
+            self._stale = True
+            raise
+        # adopt the final fork: self.sw's own clock never moved
+        self.sw = last_sw[0]
+        self.t_now = int(times[-1])
+        return results, steps
+
+    def _fold_hop_fork(self, sw, time: int, prev) -> dict:
+        """``_fold_hop_inner`` on a forked builder: fold events in
+        (prev, time] and stage the touched rows — engine state (t_now,
+        stale flag, telemetry) is the driver's business, not the
+        worker's."""
+        time = int(time)
+        with TRACER.span("hop.fold", time=time,
+                            engine="device_sweep") as sp:
+            if prev is not None and time <= prev:
+                sp.set(kind="noop")
+                return {"time": time, "kind": "noop"}
+            sw._advance(time)
+            payload = self._stage_payload(sw, time)
+            sp.set(kind=payload["kind"])
+            return payload

@@ -35,8 +35,10 @@ import numpy as np
 import logging
 
 from ..core.events import EventLog
-from ..core.sweep import SweepBuilder
+from ..core.sweep import (SweepBuilder, fold_cache, fold_pool, fold_workers,
+                          log_fingerprint, prefetch_map)
 from ..obs.trace import TRACER
+from ..utils.transfer import _metrics
 from .device_sweep import (GlobalTables, _device_edges, normalize_windows,
                            sweep_phase_summary)
 
@@ -605,6 +607,18 @@ def run_cc_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
                              hop_of_col, T_col, w_col, e_src_dev, e_dst_dev)
 
 
+def _payload_nbytes(obj) -> int:
+    """Recursive numpy-array byte count of a fold payload — what the
+    bounded fold cache accounts an entry at."""
+    if obj is None:
+        return 0
+    if isinstance(obj, np.ndarray):
+        return obj.nbytes
+    if isinstance(obj, (list, tuple)):
+        return sum(_payload_nbytes(x) for x in obj)
+    return 8   # scalars (hop times in vshell rows)
+
+
 class _HopBatched:
     """Shared incremental fold → per-hop state columns (deletes included).
 
@@ -701,6 +715,11 @@ class _HopBatched:
     #: weight state from base + per-hop deltas)
     supports_delta_fold = False
 
+    #: set False by subclasses whose fold threads extra SEQUENTIAL state
+    #: through the engine (SSSP's weight cursor) — they keep the serial
+    #: shared-builder pipeline regardless of ``RTPU_FOLD_WORKERS``
+    supports_parallel_fold = True
+
     def _use_delta_fold(self) -> bool:
         import os
 
@@ -736,7 +755,16 @@ class _HopBatched:
         additionally initialises each chunk's columns from the previous
         chunk's LAST-hop ranks (same fixed point, reached in far fewer
         steps when consecutive hops differ little). Warm-started results
-        agree with cold ones to the solver tolerance, not bitwise."""
+        agree with cold ones to the solver tolerance, not bitwise.
+
+        With ``RTPU_FOLD_WORKERS`` > 1 the chunk folds run CONCURRENTLY
+        on forked builders (bit-identical payloads — docs/FOLD.md), and
+        ``hop_callback`` may fire from worker threads in any hop order —
+        key captures by the hop time argument, never by call order. An
+        exact (log, hop grid) repeat serves its fold from the bounded
+        cross-request fold cache (``RTPU_FOLD_CACHE_MB``); on a hit the
+        callback replays from cached per-hop vertex state and
+        ``fold_seconds`` stays ~0."""
         self.fold_seconds = 0.0
         self.fold_stall_seconds = 0.0
         self.ship_bytes = 0
@@ -782,8 +810,99 @@ class _HopBatched:
 
         return os.environ.get("RTPU_PREFETCH", "1") != "0"
 
+    def _observe_fold(self, seconds: float, mode: str) -> None:
+        m = _metrics()
+        if m is not None:
+            m.fold_seconds.labels(mode).observe(float(seconds))
+
+    def _fold_token(self):
+        """Engine-specific component of the fold-cache key. The base fold
+        payload depends only on the log and the hop grid — PageRank, CC
+        and BFS over the same log SHARE cached payloads; engines whose
+        fold carries extra state (SSSP weights) must disambiguate."""
+        return None
+
+    def _cache_key(self, cache, delta: bool, hop_times, n_groups: int):
+        if cache is None:
+            return None
+        if self.sw.t_prev is not None and hop_times[0] < self.sw.t_prev:
+            return None   # the fold path owns the backward-batch refusal
+        if len(set(hop_times)) != len(hop_times):
+            return None   # duplicate hops: capture order is ambiguous
+        # the per-hop vertex-state capture (shell replay) alone would
+        # outgrow the bound at scale — don't materialise H*n*17 bytes the
+        # put would only refuse
+        if len(hop_times) * len(self.sw.uv) * 17 > cache.max_bytes:
+            return None
+        return ("fold", log_fingerprint(self.sw.log), self._fold_token(),
+                "delta" if delta else "cols", tuple(hop_times),
+                int(n_groups))
+
+    @staticmethod
+    def _capture_cb(hop_callback, cap):
+        """Wrap ``hop_callback`` to ALSO capture the per-hop vertex fold
+        state (the reducer-shell inputs) into ``cap`` — what a fold-cache
+        hit replays so callback-bearing jobs can skip folding too."""
+        if cap is None:
+            return hop_callback
+
+        def cb(T, sw):
+            cap.append((int(T), sw.v_lat.copy(), sw.v_alive.copy(),
+                        sw.v_first.copy()))
+            if hop_callback is not None:
+                hop_callback(T, sw)
+        return cb
+
+    @staticmethod
+    def _replay_vshells(vshells, hop_callback) -> None:
+        from types import SimpleNamespace
+
+        for T, vl, va, vf in vshells:
+            hop_callback(T, SimpleNamespace(v_lat=vl, v_alive=va,
+                                            v_first=vf))
+
+    def _maybe_cache(self, cache, key, payloads, cap, delta) -> None:
+        """Insert this sweep's fold output into the cross-request cache.
+        Delta payloads are only replayable on a fresh engine when group 0
+        shipped a full base snapshot (a resident fold's payload assumes
+        THIS engine's device state)."""
+        if cache is None or key is None or any(
+                p is None for p in payloads):
+            return
+        if delta and payloads[0][0] is None:
+            return
+        vshells = sorted(cap, key=lambda r: r[0]) if cap else None
+        nbytes = _payload_nbytes(payloads) + _payload_nbytes(vshells)
+        cache.put(key, (list(payloads), vshells), nbytes)
+
+    def _dispatch_group(self, payload, group, windows, delta, warm_start,
+                        outs, steps_box) -> None:
+        r_init = None
+        if warm_start and outs:
+            # previous chunk's FULL output; the kernel slices its last
+            # hop's W windowed rows and tiles them per hop of this
+            # group IN-PROGRAM — no extra host-issued device ops
+            # between dispatches (each is a tunnel round-trip)
+            r_init = outs[-1]                              # [per*W, n_pad]
+        if delta:
+            out, st = self._dispatch_deltas(payload, group, windows,
+                                            r_init=r_init)  # async
+        else:
+            out, st = self._dispatch_cols(payload, group, windows,
+                                          r_init=r_init)   # async
+        outs.append(out)
+        steps_box[0] = jnp.maximum(steps_box[0], st)
+
     def _run_chunks(self, hop_times, windows, chunks, warm_start,
                     hop_callback):
+        if sorted(hop_times) != hop_times:
+            raise ValueError("hop_times must ascend")
+        if self.sw.t_prev is not None and hop_times[0] < self.sw.t_prev:
+            raise ValueError(
+                f"hop_times must continue forward from the previous batch "
+                f"(got {hop_times[0]} < {self.sw.t_prev}); build a fresh "
+                f"{type(self).__name__} to go back in history")
+        delta = self._use_delta_fold()
         if chunks == 1 or len(hop_times) % chunks:
             # unequal groups would compile one program per distinct size —
             # pipeline only when the split is clean
@@ -792,74 +911,387 @@ class _HopBatched:
                     "%d hops do not split into %d equal chunks — running "
                     "one cold dispatch (warm_start has no effect)",
                     len(hop_times), chunks)
-            delta = self._use_delta_fold()
-            with TRACER.span("hop.fold", hops=len(hop_times),
-                                engine=type(self).__name__):
-                if delta:
-                    hop_times, payload = self._fold_deltas(hop_times,
-                                                           hop_callback)
-                else:
-                    hop_times, payload = self._fold_columns(hop_times,
-                                                            hop_callback)
-            if delta:
-                return self._dispatch_deltas(payload, hop_times, windows)
-            return self._dispatch_cols(payload, hop_times, windows)
-        per = len(hop_times) // chunks
-        delta = self._use_delta_fold()
-        groups = [hop_times[c * per: (c + 1) * per] for c in range(chunks)]
+            groups = [list(hop_times)]
+        else:
+            per = len(hop_times) // chunks
+            groups = [hop_times[c * per: (c + 1) * per]
+                      for c in range(chunks)]
 
-        def fold(group, lookahead: bool):
+        # ---- cross-request fold cache: an exact (log, hop grid) repeat
+        # skips folding entirely (the repeated-REST-range serving story)
+        cache = fold_cache()
+        key = self._cache_key(cache, delta, hop_times, len(groups))
+        if key is not None:
+            hit = cache.get(key)
+            if hit is not None:
+                payloads, vshells = hit
+                if hop_callback is None or vshells is not None:
+                    if hop_callback is not None:
+                        self._replay_vshells(vshells, hop_callback)
+                    outs, steps_box = [], [jnp.int32(0)]
+                    for c, g in enumerate(groups):
+                        self._dispatch_group(payloads[c], g, windows,
+                                             delta, warm_start, outs,
+                                             steps_box)
+                    # the hit advanced the DEVICE base to the cached
+                    # grid's last hop while the host fold clock (self.sw)
+                    # never moved — a later resident batch would scatter
+                    # an older catch-up delta onto that newer state. Drop
+                    # residency: the next batch ships a base from the
+                    # host clock, which is always consistent.
+                    self._dev_base = None
+                    return jnp.concatenate(outs, axis=0), steps_box[0]
+                # cached without shells but this job needs them: refold
+
+        workers = fold_workers()
+        if (workers > 1 and self.supports_parallel_fold
+                and self._use_prefetch() and len(hop_times) > 1):
+            return self._fold_dispatch_parallel(
+                groups, windows, warm_start, hop_callback, delta,
+                cache, key, workers)
+        return self._fold_dispatch_serial(
+            groups, windows, warm_start, hop_callback, delta, cache, key)
+
+    def _fold_dispatch_serial(self, groups, windows, warm_start,
+                              hop_callback, delta, cache, key):
+        """The shared-builder pipeline: groups fold one at a time on the
+        single prefetch worker (``RTPU_PREFETCH_DEPTH`` of them queued
+        ahead) while earlier groups ship and compute — today's behaviour,
+        and the only safe shape for engines whose fold mutates shared
+        state (``supports_parallel_fold = False``)."""
+        cap = [] if key is not None else None
+        cb = self._capture_cb(hop_callback, cap)
+        payloads = [None] * len(groups)
+        outs, steps_box = [], [jnp.int32(0)]
+
+        def fold(c, group, lookahead: bool):
             # a lookahead fold runs BEFORE the previous group's delta
             # dispatch is issued — it must assume that dispatch will leave
             # a device-resident base (assume_resident), or chunk 2+ would
             # re-ship a full base snapshot the serial loop never ships
             with TRACER.span("hop.fold", hops=len(group),
                                 engine=type(self).__name__):
+                t0 = _time.perf_counter()
                 if delta:
-                    return self._fold_deltas(group, hop_callback,
+                    _, p = self._fold_deltas(group, cb,
                                              assume_resident=lookahead)
-                return self._fold_columns(group, hop_callback)
+                else:
+                    _, p = self._fold_columns(group, cb)
+                self._observe_fold(_time.perf_counter() - t0, "serial")
+            return c, group, p
 
-        outs = []
-        steps = jnp.int32(0)
-
-        def dispatch(group_payload, stall):
-            group, payload = group_payload
+        def dispatch(fold_out, stall):
+            c, group, payload = fold_out
             self.fold_stall_seconds += stall
             if stall > 0:
                 TRACER.complete("fold.stall", stall, hops=len(group))
-            r_init = None
-            if warm_start and outs:
-                # previous chunk's FULL output; the kernel slices its last
-                # hop's W windowed rows and tiles them per hop of this
-                # group IN-PROGRAM — no extra host-issued device ops
-                # between dispatches (each is a tunnel round-trip)
-                r_init = outs[-1]                          # [per*W, n_pad]
-            if delta:
-                out, st = self._dispatch_deltas(payload, group, windows,
-                                                r_init=r_init)  # async
-            else:
-                out, st = self._dispatch_cols(payload, group, windows,
-                                              r_init=r_init)   # async
-            outs.append(out)
-            nonlocal steps
-            steps = jnp.maximum(steps, st)
+            payloads[c] = payload
+            self._dispatch_group(payload, group, windows, delta,
+                                 warm_start, outs, steps_box)
 
-        if self._use_prefetch():
+        if self._use_prefetch() and len(groups) > 1:
             # hop-lookahead prefetch: group c+1's host fold + staging run
             # in the prefetch worker while group c's payload ships and its
             # columnar program runs on device — fold → stage → ship →
             # compute. Dispatch (result order) stays on THIS thread.
-            from ..core.sweep import prefetch_map
-
             prefetch_map(
-                (functools.partial(fold, g, c > 0)
+                (functools.partial(fold, c, g, c > 0)
                  for c, g in enumerate(groups)),
                 dispatch)
         else:
-            for c in range(chunks):
-                dispatch(fold(groups[c], False), 0.0)
-        return jnp.concatenate(outs, axis=0), steps
+            for c, g in enumerate(groups):
+                dispatch(fold(c, g, False), 0.0)
+        self._maybe_cache(cache, key, payloads, cap, delta)
+        return jnp.concatenate(outs, axis=0), steps_box[0]
+
+    def fold_payloads(self, hop_times, chunks: int = 1):
+        """Fold the sweep's chunk payloads WITHOUT dispatching — the
+        serial/parallel fold A/B surface (``bench.py --config
+        fold_parallel`` and the equivalence tests). Honours
+        ``RTPU_FOLD_WORKERS`` exactly like ``run()`` (serial pipeline at
+        1, forked parallel folds above); the fold cache is never
+        consulted — this measures/exercises folding itself. Returns
+        ``(groups, payloads)``, one payload per dispatch group, identical
+        to what ``run(hop_times, ..., chunks=chunks)`` would dispatch."""
+        hop_times = [int(x) for x in hop_times]
+        chunks = max(1, min(int(chunks), len(hop_times)))
+        if chunks > 1 and len(hop_times) % chunks:
+            chunks = 1
+        per = len(hop_times) // chunks
+        groups = [hop_times[c * per:(c + 1) * per] for c in range(chunks)]
+        delta = self._use_delta_fold()
+        workers = fold_workers()
+        if (workers > 1 and self.supports_parallel_fold
+                and len(hop_times) > 1):
+            # checkpoints participate (key=None keeps payload entries
+            # out): repeated folds seed their forks at the boundaries
+            # and skip the prefix re-fold — the serving steady state
+            payloads, _ = self._fold_groups_parallel(
+                groups, None, delta, fold_cache(), None, workers,
+                lambda c, p: None)
+            return groups, payloads
+        payloads = []
+        for c, g in enumerate(groups):
+            t0 = _time.perf_counter()
+            if delta:
+                # chunks 1+ fold all-delta exactly like the pipelined
+                # run (the previous chunk's dispatch leaves a resident
+                # base); chunk 0 ships the base snapshot
+                _, p = self._fold_deltas(g, None, assume_resident=c > 0)
+            else:
+                _, p = self._fold_columns(g, None)
+            self._observe_fold(_time.perf_counter() - t0, "serial")
+            payloads.append(p)
+        return groups, payloads
+
+    def _fold_dispatch_parallel(self, groups, windows, warm_start,
+                                hop_callback, delta, cache, key, workers):
+        outs, steps_box = [], [jnp.int32(0)]
+
+        def on_payload(c, payload):
+            self._dispatch_group(payload, groups[c], windows, delta,
+                                 warm_start, outs, steps_box)
+
+        payloads, cap = self._fold_groups_parallel(
+            groups, hop_callback, delta, cache, key, workers, on_payload)
+        self._maybe_cache(cache, key, payloads, cap, delta)
+        return jnp.concatenate(outs, axis=0), steps_box[0]
+
+    def _fold_groups_parallel(self, groups, hop_callback, delta, cache,
+                              key, workers, on_payload):
+        """Parallel chunk folds: every fold unit runs on an INDEPENDENT
+        fork of the sweep's builder (seeded by one bulk advance to the
+        previous unit's boundary — or a cached checkpoint), concurrently
+        on the sized ``fold_pool``. A single dispatch group additionally
+        sub-splits across workers (every column row / delta list is
+        absolute state, so parts just concatenate). ``on_payload(c,
+        payload)`` fires on THIS thread as each dispatch group completes,
+        in group order; results are bit-identical to the serial fold
+        (tested per engine). ``hop_callback`` runs on worker threads and
+        may interleave across units — callers key their capture by hop
+        time, not call order."""
+        if len(groups) == 1 and len(groups[0]) >= 2:
+            hops0 = groups[0]
+            n_sub = min(workers, len(hops0))
+            per = -(-len(hops0) // n_sub)
+            units = [{"c": 0, "hops": hops0[u * per:(u + 1) * per],
+                      "off": u * per} for u in range(n_sub)]
+            units = [u for u in units if u["hops"]]
+        else:
+            units = [{"c": c, "hops": g, "off": 0}
+                     for c, g in enumerate(groups)]
+        left_in_group = [0] * len(groups)
+        for u in units:
+            left_in_group[u["c"]] += 1
+
+        fp = log_fingerprint(self.sw.log) if cache is not None else None
+        cfg = self.sw._config()
+        resident0 = delta and self._dev_base is not None
+        cols_out = None
+        if not delta:
+            # the host-column path advances the fold WITHOUT maintaining
+            # the running delta base — residency must drop here exactly
+            # like serial ``_fold_columns``, or a later delta batch would
+            # scatter onto a device state frozen several batches back
+            self._delta_base = None
+            self._dev_base = None
+            cols_out = [self._alloc_columns(len(g)) for g in groups]
+        cap = [] if key is not None else None
+        cb = self._capture_cb(hop_callback, cap)
+
+        def make_task(u: int):
+            unit = units[u]
+            if u > 0:
+                boundary = int(units[u - 1]["hops"][-1])
+            elif delta and resident0:
+                # the resident chain pins unit 0 to the live engine
+                # clock: its catch-up delta must cover exactly
+                # (engine clock, first hop] — a checkpoint seed ahead of
+                # the clock would drop updates the device never saw
+                boundary = None
+            else:
+                # non-resident unit 0 emits ABSOLUTE state (base snapshot
+                # / column rows) — seed it at its own first hop so a warm
+                # checkpoint store removes the hop-0 bulk fold too
+                boundary = int(unit["hops"][0])
+
+            def task():
+                t0 = _time.perf_counter()
+                with TRACER.span("hop.fold", hops=len(unit["hops"]),
+                                    engine=type(self).__name__,
+                                    mode="parallel"):
+                    sw = self._seed_fork(boundary, cache, fp, cfg)
+                    if delta:
+                        ship = unit["c"] == 0 and unit["off"] == 0 \
+                            and not resident0
+                        part = self._fold_deltas_fork(sw, unit["hops"],
+                                                      ship, cb)
+                    else:
+                        part = None
+                        self._fold_columns_fork(sw, unit["hops"], cb,
+                                                cols_out[unit["c"]],
+                                                unit["off"])
+                return u, sw, part, _time.perf_counter() - t0
+            return task
+
+        pending: dict[int, list] = {}
+        payloads = [None] * len(groups)
+        last_sw = [None]
+
+        def consume(res, stall):
+            u, sw, part, dt = res
+            self.fold_seconds += dt
+            self._observe_fold(dt, "parallel")
+            self.fold_stall_seconds += stall
+            if stall > 0:
+                TRACER.complete("fold.stall", stall,
+                                   hops=len(units[u]["hops"]))
+            last_sw[0] = sw
+            c = units[u]["c"]
+            pending.setdefault(c, []).append(part)
+            left_in_group[c] -= 1
+            if left_in_group[c]:
+                return
+            parts = pending.pop(c)
+            if delta:
+                payload = parts[0] if len(parts) == 1 \
+                    else self._merge_delta_parts(parts)
+            else:
+                payload = cols_out[c]
+                self.ship_bytes += sum(a.nbytes for a in payload)
+            payloads[c] = payload
+            on_payload(c, payload)
+
+        prefetch_map([make_task(u) for u in range(len(units))], consume,
+                     depth=len(units), pool=fold_pool())
+        # adopt the final fork: the engine's host fold clock ends at the
+        # sweep's last hop, exactly like the serial path. The running
+        # host base was never advanced — drop it (resident batches
+        # re-materialise it lazily from the adopted builder's state).
+        self.sw = last_sw[0]
+        self._delta_base = None
+        return payloads, cap
+
+    def _seed_fork(self, boundary, cache, fp, cfg):
+        """Fork the sweep's builder at ``boundary`` (exclusive upper time
+        of every earlier unit's hops): nearest cached checkpoint when one
+        is ahead of the live builder, else the live state, then one bulk
+        advance — recorded back as a checkpoint for the next request."""
+        if boundary is None:
+            return self.sw.fork()
+        cp = cache.nearest_checkpoint(fp, cfg, boundary) \
+            if cache is not None else None
+        t0 = self.sw.t_prev
+        if cp is not None and (t0 is None or cp.t_prev > t0):
+            sw = self.sw.fork(cp)
+        else:
+            sw = self.sw.fork()
+        if sw.t_prev is None or sw.t_prev < boundary:
+            with TRACER.span("fold.checkpoint", time=int(boundary),
+                                seeded_from=(-1 if sw.t_prev is None
+                                             else int(sw.t_prev))):
+                sw._advance(boundary)
+            if cache is not None:
+                cache.put_checkpoint(fp, sw.checkpoint())
+        return sw
+
+    @staticmethod
+    def _merge_delta_parts(parts):
+        """Concatenate sub-unit delta payloads of ONE dispatch group:
+        part 0 may carry the base; per-hop delta lists append in hop
+        order (each sub-unit's hop 0 is the catch-up delta from the
+        previous unit's boundary — exactly the serial fold's windows)."""
+        base = parts[0][0]
+        deltas_e, deltas_v = [], []
+        for p in parts:
+            deltas_e.extend(p[1])
+            deltas_v.extend(p[2])
+        return (base, deltas_e, deltas_v)
+
+    def _alloc_columns(self, H: int):
+        t = self.tables
+        return (np.full((H, t.m_pad), t.tmin, t.tdtype),
+                np.zeros((H, t.m_pad), bool),
+                np.full((H, t.n_pad), t.tmin, t.tdtype),
+                np.zeros((H, t.n_pad), bool))
+
+    def _fold_columns_fork(self, sw, group, hop_callback, out,
+                           off: int) -> None:
+        """Column fold of one unit on a FORKED builder, written into
+        ``out`` rows [off, off+len): every row is absolute fold state, so
+        units fold independently and the assembled arrays are
+        bit-identical to the serial ``_fold_columns``."""
+        t = self.tables
+        e_lat, e_alive, v_lat, v_alive = out
+        for j, T in enumerate(group):
+            sw._advance(T)
+            if hop_callback is not None:
+                hop_callback(T, sw)
+            r = off + j
+            if j == 0:
+                pos = t.eng_pos(sw.e_enc)
+                e_lat[r, pos] = t.cast_times(sw.e_lat)
+                e_alive[r, pos] = sw.e_alive
+                nv = len(sw.uv)
+                v_lat[r, :nv] = t.cast_times(sw.v_lat)
+                v_alive[r, :nv] = sw.v_alive
+                continue
+            e_lat[r] = e_lat[r - 1]
+            e_alive[r] = e_alive[r - 1]
+            v_lat[r] = v_lat[r - 1]
+            v_alive[r] = v_alive[r - 1]
+            d = sw.last_delta
+            if len(d["e_enc"]):
+                dpos = t.eng_pos(d["e_enc"])
+                e_lat[r, dpos] = t.cast_times(d["e_lat"])
+                e_alive[r, dpos] = d["e_alive"]
+            if len(d["v_idx"]):
+                v_lat[r, d["v_idx"]] = t.cast_times(d["v_lat"])
+                v_alive[r, d["v_idx"]] = d["v_alive"]
+
+    def _fold_deltas_fork(self, sw, group, ship_base: bool, hop_callback):
+        """Delta fold of one unit on a FORKED builder — the parallel twin
+        of ``_fold_deltas``: no engine state is touched, so any number of
+        units fold concurrently. ``ship_base`` makes hop 0 a full base
+        snapshot (the first unit of a non-resident sweep); otherwise
+        every hop ships as a delta, hop 0 being the catch-up from the
+        previous unit's boundary — the same windows the serial fold
+        produces, so the assembled payload is bit-identical."""
+        tdt = self.tables.tdtype
+        deltas_e, deltas_v = [], []
+        base = None
+        empty = (np.empty(0, np.int32), np.empty(0, tdt),
+                 np.empty(0, bool))
+        for j, T in enumerate(group):
+            sw._advance(T)
+            if hop_callback is not None:
+                hop_callback(T, sw)
+            if j == 0 and ship_base:
+                base = self._materialise_base(sw)
+                deltas_e.append(empty)
+                deltas_v.append(empty)
+            else:
+                de, dv = self._delta_eng(sw.last_delta)
+                deltas_e.append(de)
+                deltas_v.append(dv)
+        return (base, deltas_e, deltas_v)
+
+    def _materialise_base(self, sw):
+        """Full engine-coordinate base arrays from a builder's fold state
+        (the delta path's hop-0 snapshot)."""
+        t = self.tables
+        tdt = t.tdtype
+        be_lat = np.full(t.m_pad, t.tmin, tdt)
+        be_alive = np.zeros(t.m_pad, bool)
+        pos = t.eng_pos(sw.e_enc)
+        be_lat[pos] = t.cast_times(sw.e_lat)
+        be_alive[pos] = sw.e_alive
+        bv_lat = np.full(t.n_pad, t.tmin, tdt)
+        bv_alive = np.zeros(t.n_pad, bool)
+        nv = len(sw.uv)
+        bv_lat[:nv] = t.cast_times(sw.v_lat)
+        bv_alive[:nv] = sw.v_alive
+        return (be_lat, be_alive, bv_lat, bv_alive)
 
     def _fold_columns(self, hop_times, hop_callback=None):
         f0 = _time.perf_counter()
@@ -924,23 +1356,27 @@ class _HopBatched:
                             + v_lat.nbytes + v_alive.nbytes)
         return hop_times, (e_lat, e_alive, v_lat, v_alive)
 
+    def _delta_eng(self, d):
+        """``sweep.last_delta`` → engine-coordinate (pos, lat, alive)
+        triples — shared by the running-base scatter and the forked
+        parallel fold."""
+        t = self.tables
+        de = (t.eng_pos(d["e_enc"]).astype(np.int32),
+              t.cast_times(d["e_lat"]), d["e_alive"].astype(bool))
+        dv = (d["v_idx"].astype(np.int32), t.cast_times(d["v_lat"]),
+              d["v_alive"].astype(bool))
+        return de, dv
+
     def _apply_delta_to_base(self):
         """Scatter the sweep's last delta into the RUNNING host base
         (O(delta)); returns the delta in engine coordinates."""
-        t = self.tables
-        d = self.sw.last_delta
-        epos = t.eng_pos(d["e_enc"]).astype(np.int32)
-        e_lat = t.cast_times(d["e_lat"])
-        e_alive = d["e_alive"].astype(bool)
-        v_idx = d["v_idx"].astype(np.int32)
-        v_lat = t.cast_times(d["v_lat"])
-        v_alive = d["v_alive"].astype(bool)
+        de, dv = self._delta_eng(self.sw.last_delta)
         be_lat, be_alive, bv_lat, bv_alive = self._delta_base
-        be_lat[epos] = e_lat
-        be_alive[epos] = e_alive
-        bv_lat[v_idx] = v_lat
-        bv_alive[v_idx] = v_alive
-        return (epos, e_lat, e_alive), (v_idx, v_lat, v_alive)
+        be_lat[de[0]] = de[1]
+        be_alive[de[0]] = de[2]
+        bv_lat[dv[0]] = dv[1]
+        bv_alive[dv[0]] = dv[2]
+        return de, dv
 
     def _fold_deltas(self, hop_times, hop_callback=None,
                      assume_resident: bool = False):
@@ -971,8 +1407,15 @@ class _HopBatched:
         ship_base = None
         # a live device-resident base makes this batch all-delta: hop 0's
         # catch-up ships in the delta[0] slot instead of a base snapshot
-        resident = ((assume_resident or self._dev_base is not None)
-                    and self._delta_base is not None)
+        resident = assume_resident or self._dev_base is not None
+        if resident and self._delta_base is None \
+                and self.sw.t_prev is not None:
+            # a parallel fold adopted a forked builder and dropped the
+            # running base — rebuild it at the adopted clock (the same
+            # time the device-resident state sits at) so the resident
+            # all-delta contract survives across batch styles
+            self._delta_base = list(self._materialise_base(self.sw))
+        resident = resident and self._delta_base is not None
         empty = (np.empty(0, np.int32), np.empty(0, tdt),
                  np.empty(0, bool))
         for j, T in enumerate(hop_times):
@@ -981,17 +1424,7 @@ class _HopBatched:
                 hop_callback(T, self.sw)
             if self._delta_base is None:
                 # first batch, first hop: materialise from the full fold
-                be_lat = np.full(t.m_pad, t.tmin, tdt)
-                be_alive = np.zeros(t.m_pad, bool)
-                pos = t.eng_pos(self.sw.e_enc)
-                be_lat[pos] = t.cast_times(self.sw.e_lat)
-                be_alive[pos] = self.sw.e_alive
-                bv_lat = np.full(t.n_pad, t.tmin, tdt)
-                bv_alive = np.zeros(t.n_pad, bool)
-                nv = len(self.sw.uv)
-                bv_lat[:nv] = t.cast_times(self.sw.v_lat)
-                bv_alive[:nv] = self.sw.v_alive
-                self._delta_base = [be_lat, be_alive, bv_lat, bv_alive]
+                self._delta_base = list(self._materialise_base(self.sw))
             else:
                 de, dv = self._apply_delta_to_base()
                 if j > 0 or resident:
@@ -1101,6 +1534,15 @@ class HopBatchedSSSP(HopBatchedBFS):
     (earliest-wins) are refused — the ascending fold is last-wins."""
 
     supports_delta_fold = True   # weights rebuild on device too
+
+    #: the weight fold advances a SEQUENTIAL cursor over the sorted
+    #: update stream — chunk folds cannot fork it independently yet
+    supports_parallel_fold = False
+
+    def _fold_token(self):
+        # weighted payloads carry per-pair weight state — never share a
+        # cache entry with the weightless engines (or other weight keys)
+        return ("sssp", self.weight_prop, bool(self.directed))
 
     def host_column_bytes(self, n_hops: int) -> int:
         extra = self.tables.m_pad * 4   # weight base (delta path)

@@ -313,10 +313,13 @@ class Job:
         if self._kill.is_set():
             return True
 
-        shells = []
+        # keyed by hop time, not call order: with parallel chunk folds
+        # (and fold-cache replays) the callback may fire from worker
+        # threads, interleaved across chunk groups
+        shells = {}
 
         def grab_shell(T, sw):
-            shells.append(_shell_from_fold(hb.tables, sw, int(T)))
+            shells[int(T)] = _shell_from_fold(hb.tables, sw, int(T))
 
         chunks = next((k for k in (4, 3, 2)
                        if len(hops) >= 2 * k and len(hops) % k == 0), 1)
@@ -346,7 +349,8 @@ class Job:
         """Emit one result row per (hop, window) column of a whole-range
         dispatch: viewTime is the AMORTISED share of the dispatch (plus
         that row's own reduce), snapshot-build is the per-hop share of the
-        measured incremental fold."""
+        measured incremental fold. ``shells`` is keyed by hop time (the
+        fold callback may fire out of hop order under parallel folds)."""
         W = len(windows)
         per_row = elapsed / max(len(hops) * W, 1)
         for _ in hops:
@@ -357,7 +361,7 @@ class Job:
             if self._kill.is_set():
                 return
             for i, w in enumerate(windows):
-                self._emit(T, w, ranks[j * W + i], shells[j], steps,
+                self._emit(T, w, ranks[j * W + i], shells[int(T)], steps,
                            _time.perf_counter() - per_row)
 
     def _try_range_mesh_columns(self, q: RangeQuery) -> bool:
@@ -390,10 +394,10 @@ class Job:
             kw = dict(kind="bfs", seeds=hb.seeds, directed=hb.directed,
                       max_steps=hb.max_steps)
 
-        shells = []
+        shells = {}
 
         def grab_shell(T, sw):
-            shells.append(_shell_from_fold(hb.tables, sw, int(T)))
+            shells[int(T)] = _shell_from_fold(hb.tables, sw, int(T))
 
         t0 = _time.perf_counter()
         _, cols = hb._fold_columns(hops, grab_shell)

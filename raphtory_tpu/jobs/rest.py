@@ -55,6 +55,16 @@ def _compile_cache_sizes() -> dict:
     return out
 
 
+def _fold_cache_status() -> dict:
+    """Cross-request fold-cache occupancy + hit rates (core/sweep)."""
+    from ..core.sweep import fold_cache
+
+    cache = fold_cache()
+    if cache is None:
+        return {"enabled": False}
+    return {"enabled": True, **cache.stats()}
+
+
 def _statusz(manager: AnalysisManager) -> dict:
     from ..utils.transfer import shared_engine
 
@@ -70,6 +80,7 @@ def _statusz(manager: AnalysisManager) -> dict:
         },
         "transfer": {"depth": eng.depth, **eng.stats.as_dict()},
         "compile_caches": _compile_cache_sizes(),
+        "fold_cache": _fold_cache_status(),
         "trace": TRACER.status(),
     }
     try:

@@ -106,6 +106,26 @@ class Metrics:
         self.h2d_inflight_depth = Gauge(
             "raphtory_h2d_inflight_depth",
             "High-water in-flight device_put window depth", registry=r)
+        self.fold_seconds = Histogram(
+            "raphtory_fold_seconds",
+            "Host fold wall seconds per chunk-group fold (mode=serial is "
+            "the shared-builder pipeline lane, mode=parallel a forked "
+            "per-chunk fold on the sized RTPU_FOLD_WORKERS pool)",
+            ["mode"], registry=r)
+        self.fold_cache_hits = Counter(
+            "raphtory_fold_cache_hits_total",
+            "Cross-request fold-cache hits (payloads + checkpoint seeds)",
+            registry=r)
+        self.fold_cache_misses = Counter(
+            "raphtory_fold_cache_misses_total",
+            "Cross-request fold-cache misses", registry=r)
+        self.fold_cache_evictions = Counter(
+            "raphtory_fold_cache_evictions_total",
+            "Fold-cache LRU evictions under the RTPU_FOLD_CACHE_MB bound",
+            registry=r)
+        self.fold_cache_bytes = Gauge(
+            "raphtory_fold_cache_bytes",
+            "Bytes currently accounted to the fold cache", registry=r)
         self.sweep_phase_seconds = Histogram(
             "raphtory_sweep_phase_seconds",
             "Per-sweep wall seconds by pipeline phase (fold=host delta "

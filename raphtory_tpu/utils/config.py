@@ -35,6 +35,32 @@ def _env_str(name: str, default: str) -> str:
     return os.environ.get(name, default)
 
 
+def configure_compile_cache() -> str | None:
+    """Wire JAX's persistent compilation cache to ``RTPU_COMPILE_CACHE_DIR``.
+
+    Short TPU tunnel windows re-pay every XLA compile on each fresh
+    process; with a cache dir set, compiled programs persist across runs
+    (and across the bench's config subprocesses). The thresholds drop to
+    zero so even fast compiles persist — the sweep engines compile many
+    small per-shape programs whose compile times sit under JAX's default
+    1s floor. Returns the directory when wired, None when the knob is
+    unset; called from package import (harmless before jax is first
+    used), safe to call again."""
+    path = os.environ.get("RTPU_COMPILE_CACHE_DIR", "")
+    if not path:
+        return None
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(knob, val)
+        except (AttributeError, ValueError):   # older jax: keep defaults
+            pass
+    return path
+
+
 @dataclass
 class Settings:
     """All behaviour flags. Defaults match the reference's defaults where a
