@@ -1320,9 +1320,119 @@ def bench_fold_parallel():
     }
 
 
+def bench_ledger_overhead():
+    """Resource-ledger overhead on the headline sweep shape — the cost
+    accounting's proof row (acceptance: < 2% on-vs-off).
+
+    Interleaved RTPU_LEDGER=0/1 pairs (same drift logic as
+    trace_overhead: sequential A-then-B on a shared box reads drift as
+    overhead) of the GAB-scale windowed-PageRank columnar sweep, with a
+    jobs-style Ledger ACTIVATED on the on-arm so every per-dispatch
+    attribution path is exercised (kernel registry lookups, phase + fold
+    accounting, transfer deltas). The XLA cost/memory harvest runs once
+    per (kernel, shapes) in the untimed warmup, exactly as it does in a
+    long-lived server. The on-arm's closed ledger snapshot rides in the
+    row — the per-phase/per-kernel numbers tools/perfwatch watches next
+    to the wall-clock value. RTPU_BENCH_CHEAP=1 shrinks the log for CI
+    runners (the value is a machine-portable percent either way)."""
+    from raphtory_tpu.engine.hopbatch import HopBatchedPageRank
+    from raphtory_tpu.obs import ledger as ledger_mod
+    from raphtory_tpu.utils.synth import gab_like_log
+
+    cheap = os.environ.get("RTPU_BENCH_CHEAP", "0") not in ("", "0")
+    if cheap:
+        log = gab_like_log(n_vertices=8_000, n_edges=80_000,
+                           t_span=_GAB_SPAN)
+        n_hops = 8
+    else:
+        log = _gab_log()
+        n_hops = 12
+    view_times = np.linspace(0.45 * _GAB_SPAN, _GAB_SPAN,
+                             n_hops).astype(np.int64)
+    windows = [2_600_000, 604_800, 86_400]
+    hops = [int(T) for T in view_times]
+    n_chunks = _chunks(2 if cheap else 3, "PR")
+    n_views = len(hops) * len(windows)
+
+    saved = os.environ.get("RTPU_LEDGER")
+
+    def setenv(v):
+        if v is None:
+            os.environ.pop("RTPU_LEDGER", None)
+        else:
+            os.environ["RTPU_LEDGER"] = v
+
+    def once(with_ledger):
+        hb = HopBatchedPageRank(log, tol=1e-7, max_steps=20)
+        led = ledger_mod.Ledger("bench_ledger_overhead", "PageRank")
+        t0 = _time.perf_counter()
+        if with_ledger:
+            with ledger_mod.activate(led):
+                ranks, _ = hb.run(hops, windows, chunks=n_chunks,
+                                  warm_start=True)
+                b0 = _time.perf_counter()
+                _sync(ranks)
+                # what the jobs layer records as device_wait (the sweep's
+                # async dispatches drain here, outside the sweep span)
+                led.add_phase("device_wait", _time.perf_counter() - b0)
+        else:
+            ranks, _ = hb.run(hops, windows, chunks=n_chunks,
+                              warm_start=True)
+            _sync(ranks)
+        dt = _time.perf_counter() - t0
+        led.finish(dt)
+        return dt, led
+
+    try:
+        setenv("1")
+        once(True)    # warm: compiles + fold cache + XLA harvest, untimed
+        offs, ons = [], []
+        led_on = None
+        for _ in range(3):    # interleaved off/on pairs
+            setenv("0")
+            offs.append(once(False)[0])
+            setenv("1")
+            dt, led_on = once(True)
+            ons.append(dt)
+    finally:
+        setenv(saved)
+
+    off_s, on_s = min(offs), min(ons)
+    overhead = on_s / off_s - 1.0
+    snap = led_on.as_dict()
+    return {
+        "metric": ("resource-ledger overhead on the sweep config "
+                   "(RTPU_LEDGER on vs off, GAB-scale columnar "
+                   "windowed-PageRank range)"),
+        "value": round(overhead * 100.0, 2),
+        "unit": "percent_slower_with_ledger",
+        "detail": {
+            "n_views": n_views,
+            "engine": "hop_batched_columnar",
+            "cheap_mode": cheap,
+            "timing": ("interleaved_pairs_best_of_3_warm_fold_cache — "
+                       "both arms serve their fold from the cross-request "
+                       "cache, the serving steady state"),
+            "ledger_off_seconds": round(off_s, 4),
+            "ledger_on_seconds": round(on_s, 4),
+            "ledger_off_repeats": [round(x, 4) for x in offs],
+            "ledger_on_repeats": [round(x, 4) for x in ons],
+            "acceptance": "on/off regression must stay < 2%",
+            # the snapshot perfwatch reads next to the wall numbers: the
+            # on-arm's closed per-query ledger + the kernel registry's
+            # harvested roofline classifications
+            "ledger": snap,
+            "kernels": ledger_mod.REGISTRY.snapshot(),
+            "xla_caps": ledger_mod.xla_analysis_caps(),
+            "baseline": "the ledger-off column of this same row",
+        },
+    }
+
+
 CONFIGS = {
     "headline": bench_headline,
     "fold_parallel": bench_fold_parallel,
+    "ledger_overhead": bench_ledger_overhead,
     "transfer_pipeline": bench_transfer_pipeline,
     "trace_overhead": bench_trace_overhead,
     "gab_cc_range": bench_gab_cc_range,

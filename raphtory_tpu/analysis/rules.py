@@ -392,17 +392,30 @@ def _check_nondeterminism_in_trace(mod: Module) -> list[Finding]:
 def _donating_factories(mod: Module) -> dict:
     """name → donated positional indices, for module functions that return
     ``jax.jit(..., donate_argnums=...)`` — the repo's compiled-factory
-    idiom."""
+    idiom. The jit call may be WRAPPED in another call (the ledger's
+    ``instrument(name, jax.jit(..., donate_argnums=...))`` idiom): the
+    wrapper dispatches through to the jitted callable, so donation
+    semantics — and this rule — must see through it."""
     out = {}
     for name, fns in mod.functions.items():
         for fn in fns:
             for node in ast.walk(fn):
-                if isinstance(node, ast.Return) and \
-                        isinstance(node.value, ast.Call) and \
-                        _is_jit_call(node.value):
-                    pos = _donated_positions(node.value)
-                    if pos:
-                        out[name] = pos
+                if not isinstance(node, ast.Return) or \
+                        not isinstance(node.value, ast.Call):
+                    continue
+                jit_call = None
+                if _is_jit_call(node.value):
+                    jit_call = node.value
+                else:   # wrapper(... jax.jit(...) ...): unwrap one level
+                    for arg in node.value.args:
+                        if isinstance(arg, ast.Call) and _is_jit_call(arg):
+                            jit_call = arg
+                            break
+                if jit_call is None:
+                    continue
+                pos = _donated_positions(jit_call)
+                if pos:
+                    out[name] = pos
     return out
 
 

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.snapshot import GraphView
+from ..obs import ledger as _ledger
 from ..obs.trace import TRACER, block_steps
 from ..ops.segment import segment_combine, segment_sum_sorted_csr
 from .program import Context, Edges, VertexProgram
@@ -216,7 +217,8 @@ def _compiled_runner(program: VertexProgram, n: int, m: int, k: int,
     the reference never had (fresh handshake per hop,
     ``RangeAnalysisTask.scala:18-35``).
     """
-    return jax.jit(make_runner(program, n, m, k))
+    return _ledger.instrument(f"bsp.superstep.{type(program).__name__}",
+                              jax.jit(make_runner(program, n, m, k)))
 
 
 def _gather_props(view: GraphView, keys, kind: str):

@@ -47,6 +47,7 @@ from ..core.events import EDGE_ADD, EDGE_DELETE, EventLog
 from ..core.snapshot import INT64_MIN, _pad_bucket
 from ..core.sweep import _ENC_MASK, _ENC_SHIFT, SweepBuilder
 from ..native import lib as _native
+from ..obs import ledger as _ledger
 from ..obs.trace import TRACER
 from ..utils.transfer import _metrics
 from .bsp import make_mask_runner
@@ -54,7 +55,7 @@ from .program import VertexProgram
 
 
 def sweep_phase_summary(sp, elapsed, fold_seconds, fold_stall_seconds,
-                        ship_delta, ship_bytes, n_hops):
+                        ship_delta, ship_bytes, n_hops, fold_modes=None):
     """Per-sweep fold/stage/ship/compute phase breakdown, attached to the
     sweep span AND observed into ``raphtory_sweep_phase_seconds{phase}``
     — shared by both sweep engines. ``fold`` is host fold+staging time
@@ -87,6 +88,12 @@ def sweep_phase_summary(sp, elapsed, fold_seconds, fold_stall_seconds,
     if m is not None:
         for ph, sec in phases.items():
             m.sweep_phase_seconds.labels(ph).observe(sec)
+    led = _ledger.current()
+    if led is not None:
+        # per-query cost attribution: the sweep ran on THIS (the job's)
+        # thread, so the thread-local ledger is the owning query's
+        led.add_sweep(phases, ship_delta, ship_bytes, n_hops,
+                      fold_modes=fold_modes)
     sp.set(elapsed_seconds=round(float(elapsed), 6),
            fold_stall_seconds=round(float(fold_stall_seconds), 6),
            ship_bytes=int(ship_bytes), n_hops=int(n_hops),
@@ -235,7 +242,9 @@ def _compiled_apply(cap_v: int, cap_e: int, tdt: str):
         e_first = e_first.at[e_idx].set(ed_first, mode="drop")
         return v_lat, v_alive, v_first, e_lat, e_alive, e_first
 
-    return jax.jit(apply, donate_argnums=(0, 1, 2, 3, 4, 5))
+    return _ledger.instrument(
+        "device_sweep.apply",
+        jax.jit(apply, donate_argnums=(0, 1, 2, 3, 4, 5)))
 
 
 @functools.lru_cache(maxsize=256)
@@ -270,7 +279,8 @@ def _compiled_run(program: VertexProgram, n: int, m: int, k: int, tdt: str):
         return core(v_masks, e_masks, vids, v_lat, v_first,
                     e_src, e_dst, e_lat, e_first, time, windows, {}, {})
 
-    return jax.jit(run)
+    return _ledger.instrument(
+        f"device_sweep.superstep.{type(program).__name__}", jax.jit(run))
 
 
 class DeviceSweep:
@@ -327,6 +337,11 @@ class DeviceSweep:
         #: host seconds spent folding + staging (includes worker-thread time
         #: when run_sweep pipelines) and fold-state bytes staged for H2D
         self.fold_seconds = 0.0
+        #: fold seconds split by pipeline mode (serial lane vs forked
+        #: parallel folds) — the resource ledger's fold breakdown; single
+        #: writer per mode (the one prefetch worker, or the dispatch
+        #: thread's consume), like fold_seconds itself
+        self.fold_mode_seconds: dict = {}
         self.ship_bytes = 0
         #: run_sweep only: seconds the dispatch loop spent WAITING on the
         #: lookahead fold — 0 means the fold fully hid behind device compute
@@ -378,13 +393,18 @@ class DeviceSweep:
             self._stale = False
             payload = {"time": time, "kind": "full",
                        "arrays": self._stage_full()}
-            self.fold_seconds += _time.perf_counter() - f0
+            self._note_fold(_time.perf_counter() - f0, "serial")
             return payload
         if not advanced:   # repeat hop on healthy buffers: nothing to ship
             return {"time": time, "kind": "noop"}
         payload = self._stage_payload(self.sw, time)
-        self.fold_seconds += _time.perf_counter() - f0
+        self._note_fold(_time.perf_counter() - f0, "serial")
         return payload
+
+    def _note_fold(self, seconds: float, mode: str) -> None:
+        self.fold_seconds += seconds
+        self.fold_mode_seconds[mode] = (
+            self.fold_mode_seconds.get(mode, 0.0) + seconds)
 
     def _stage_payload(self, sw, time: int) -> dict:
         """Staged payload for ``sw``'s LAST advance (to ``time``): noop /
@@ -584,6 +604,7 @@ class DeviceSweep:
         # accumulates into fold_seconds/ship_bytes; each sweep reports
         # its own numbers, like hopbatch's run())
         self.fold_seconds = 0.0
+        self.fold_mode_seconds = {}
         self.fold_stall_seconds = 0.0
         self.ship_bytes = 0
         from ..utils.transfer import shared_engine
@@ -599,7 +620,8 @@ class DeviceSweep:
                 sp, _time.perf_counter() - t_start, self.fold_seconds,
                 self.fold_stall_seconds,
                 shared_engine().stats.delta_since(before),
-                self.ship_bytes, len(times))
+                self.ship_bytes, len(times),
+                fold_modes=self.fold_mode_seconds)
         return out
 
     def _run_sweep_impl(self, program, times, window, windows, prefetch):
@@ -695,7 +717,7 @@ class DeviceSweep:
 
         def consume(res, stall):
             sw, payloads, dt = res
-            self.fold_seconds += dt
+            self._note_fold(dt, "parallel")
             self.fold_stall_seconds += stall
             if stall > 0:
                 TRACER.complete("fold.stall", stall)

@@ -37,6 +37,7 @@ import logging
 from ..core.events import EventLog
 from ..core.sweep import (SweepBuilder, fold_cache, fold_pool, fold_workers,
                           log_fingerprint, prefetch_map)
+from ..obs import ledger as _ledger
 from ..obs.trace import TRACER
 from ..utils.transfer import _metrics
 from .device_sweep import (GlobalTables, _device_edges, normalize_windows,
@@ -262,7 +263,7 @@ def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
                                  damping, tol, max_steps, r_init=r0,
                                  tile_budget=tile_budget)
 
-    return jax.jit(run)
+    return _ledger.instrument("hopbatch.pagerank_cols", jax.jit(run))
 
 
 @functools.lru_cache(maxsize=64)
@@ -320,7 +321,7 @@ def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
                                   tile_budget=tile_budget)
         return out, steps, adv
 
-    return jax.jit(run)
+    return _ledger.instrument(f"hopbatch.delta.{kind}", jax.jit(run))
 
 
 def _pad_hop_deltas(deltas, H: int, tdt):
@@ -497,7 +498,7 @@ def _compiled_cc(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
         return _cc_columns(me, mv, e_src, e_dst, n_pad, max_steps,
                            tile_budget=tile_budget)
 
-    return jax.jit(run)
+    return _ledger.instrument("hopbatch.cc_cols", jax.jit(run))
 
 
 def _bfs_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
@@ -558,7 +559,7 @@ def _compiled_bfs(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
         return _bfs_columns(me, mv, e_src, e_dst, n_pad, max_steps,
                             directed, seed_mask, ew, tile_budget=tile_budget)
 
-    return jax.jit(run)
+    return _ledger.instrument("hopbatch.bfs_cols", jax.jit(run))
 
 
 def _seed_mask(tables, seed_vids) -> np.ndarray:
@@ -646,6 +647,12 @@ class _HopBatched:
         #: (callers report it as snapshot-build time; under the lookahead
         #: prefetcher this is WORKER time, overlapped with device compute)
         self.fold_seconds = 0.0
+        #: the LAST run()'s fold seconds split by pipeline mode
+        #: (serial / parallel / cache_hit replay) — the resource ledger's
+        #: fold breakdown. Single writer per mode within one run (the one
+        #: prefetch worker, or the dispatch thread's consume), and read
+        #: only after the run's folds have drained.
+        self.fold_mode_seconds: dict = {}
         #: seconds the LAST run()'s dispatch loop spent WAITING on the
         #: lookahead fold — 0 means the fold hid entirely behind compute
         self.fold_stall_seconds = 0.0
@@ -766,6 +773,7 @@ class _HopBatched:
         callback replays from cached per-hop vertex state and
         ``fold_seconds`` stays ~0."""
         self.fold_seconds = 0.0
+        self.fold_mode_seconds = {}
         self.fold_stall_seconds = 0.0
         self.ship_bytes = 0
         if warm_start and not self.supports_warm_start:
@@ -789,7 +797,8 @@ class _HopBatched:
                     sp, _time.perf_counter() - t_start, self.fold_seconds,
                     self.fold_stall_seconds,
                     shared_engine().stats.delta_since(before),
-                    self.ship_bytes, len(hop_times))
+                    self.ship_bytes, len(hop_times),
+                    fold_modes=self.fold_mode_seconds)
             return out
         except Exception:
             # ANY mid-run failure (fold, hop_callback, dispatch) may leave
@@ -811,6 +820,12 @@ class _HopBatched:
         return os.environ.get("RTPU_PREFETCH", "1") != "0"
 
     def _observe_fold(self, seconds: float, mode: str) -> None:
+        # the per-mode split feeds the resource ledger (fold_seconds
+        # itself stays the modes' sum EXCEPT cache_hit replay, which is
+        # accounted as a mode but never as fold time — a hit's fold cost
+        # is, by contract, 0)
+        self.fold_mode_seconds[mode] = (
+            self.fold_mode_seconds.get(mode, 0.0) + float(seconds))
         m = _metrics()
         if m is not None:
             m.fold_seconds.labels(mode).observe(float(seconds))
@@ -926,8 +941,23 @@ class _HopBatched:
             if hit is not None:
                 payloads, vshells = hit
                 if hop_callback is None or vshells is not None:
-                    if hop_callback is not None:
-                        self._replay_vshells(vshells, hop_callback)
+                    # the warm path still emits a hop.fold span (near-zero
+                    # duration, mode=cache_hit): a traced sweep's phase
+                    # timeline must show WHERE the fold went — "served
+                    # from cache" — not silently omit the phase, and the
+                    # ledger's fold breakdown records the replay the same
+                    # way (fold_seconds stays 0: a hit's fold cost IS 0)
+                    f0 = _time.perf_counter()
+                    with TRACER.span("hop.fold", hops=len(hop_times),
+                                        engine=type(self).__name__,
+                                        mode="cache_hit"):
+                        if hop_callback is not None:
+                            self._replay_vshells(vshells, hop_callback)
+                    self._observe_fold(_time.perf_counter() - f0,
+                                       "cache_hit")
+                    led = _ledger.current()
+                    if led is not None:
+                        led.fold_cache_event(hit=True)
                     outs, steps_box = [], [jnp.int32(0)]
                     for c, g in enumerate(groups):
                         self._dispatch_group(payloads[c], g, windows,
@@ -942,6 +972,12 @@ class _HopBatched:
                     self._dev_base = None
                     return jnp.concatenate(outs, axis=0), steps_box[0]
                 # cached without shells but this job needs them: refold
+            led = _ledger.current()
+            if led is not None:
+                # a None hit AND the shell-less-entry refold both cost
+                # this query a full fold — the ledger counts both as
+                # misses (the global FoldCache stats count raw lookups)
+                led.fold_cache_event(hit=False)
 
         workers = fold_workers()
         if (workers > 1 and self.supports_parallel_fold
@@ -1773,7 +1809,7 @@ def _compiled_scale(n_pad: int, m_pad: int, H: int, W: int, U_e: int,
                                  damping, tol, max_steps,
                                  tile_budget=tile_budget)
 
-    return jax.jit(run)
+    return _ledger.instrument("hopbatch.pagerank_scale", jax.jit(run))
 
 
 def _delta_fingerprint(deltas_e, deltas_v) -> tuple:

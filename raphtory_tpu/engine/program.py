@@ -139,6 +139,15 @@ class VertexProgram:
     # always safe.)
     reduce_shell_safe: bool = False
 
+    @property
+    def cost_label(self) -> str:
+        """Algorithm label the resource ledger files this program's cost
+        under (``raphtory_query_cost_*{algorithm=...}`` metrics, /costz
+        recent-query rows, kernel names in the registry). Class name by
+        default; override when one class serves several user-facing
+        algorithms."""
+        return type(self).__name__
+
     # -- pure array functions --
 
     def init(self, ctx: Context) -> Any:

@@ -20,6 +20,7 @@ from dataclasses import dataclass
 from ..core.service import TemporalGraph
 from ..engine import bsp
 from ..engine.program import VertexProgram
+from ..obs import ledger as _ledger
 from ..obs.metrics import METRICS
 from ..obs.trace import TRACER, block_steps as _block_steps
 
@@ -70,13 +71,21 @@ Query = ViewQuery | RangeQuery | LiveQuery
 
 class Job:
     def __init__(self, job_id: str, program: VertexProgram, query: Query,
-                 graph: TemporalGraph, mesh=None, wait_timeout: float = 30.0):
+                 graph: TemporalGraph, mesh=None, wait_timeout: float = 30.0,
+                 explain: bool = False):
         self.id = job_id
         self.program = program
         self.query = query
         self.graph = graph
         self.mesh = mesh
         self.wait_timeout = wait_timeout
+        #: per-query resource ledger — always collected (cheap dict
+        #: accounting); ``explain`` additionally returns it with the
+        #: results over REST (obs/ledger.py)
+        self.explain = bool(explain)
+        self.ledger = _ledger.Ledger(
+            job_id, getattr(program, "cost_label", type(program).__name__))
+        self._submitted = _time.perf_counter()
         # ResultSink | None — attached by AnalysisManager.submit (the only
         # path, so every sink went through the path jail + in-use check)
         self.sink = None
@@ -106,11 +115,48 @@ class Job:
 
     def _run(self) -> None:
         METRICS.jobs_started.labels(type(self.query).__name__).inc()
+        # queue wait = submit → job thread actually running (today that is
+        # thread-spawn latency; an admission-controlled scheduler will put
+        # real queueing here, and the ledger field is where it shows up)
+        self.ledger.queue_wait_seconds = max(
+            0.0, _time.perf_counter() - self._submitted)
         with TRACER.span("job", job_id=self.id,
                          kind=type(self.query).__name__,
-                         program=type(self.program).__name__) as jsp:
+                         program=type(self.program).__name__) as jsp, \
+                _ledger.activate(self.ledger):
             self._run_query()
             jsp.set(status=self.status)
+        # wall is submit → done, so it CONTAINS the queue wait and
+        # finish()'s residual (wall - queue_wait - phases) is exactly the
+        # unattributed run time — the queue_wait + Σphases == wall
+        # invariant holds even once real admission queueing exists
+        self._publish_ledger(_time.perf_counter() - self._submitted)
+
+    def _publish_ledger(self, wall_seconds: float) -> None:
+        """Close the job's ledger and fan it out: per-algorithm
+        ``raphtory_query_cost_*`` metrics, the /costz recent-query ring,
+        and a ``ledger.query`` flight-recorder instant. With
+        ``RTPU_LEDGER=0`` the ledger closes quietly (explain still shows
+        the jobs-layer timings) but publishes NOTHING — disabling
+        collection must silence every ledger surface, not just the
+        engine-side hooks."""
+        led = self.ledger
+        led.finish(wall_seconds, status=self.status)
+        if not _ledger.collection_enabled():
+            return
+        alg = led.algorithm or "unknown"
+        METRICS.query_cost_queries.labels(alg, led.bound()).inc()
+        METRICS.query_cost_seconds.labels(alg, "queue_wait").observe(
+            led.queue_wait_seconds)
+        snap = led.as_dict()
+        for ph, sec in snap["phase_seconds"].items():
+            METRICS.query_cost_seconds.labels(alg, ph).observe(sec)
+        METRICS.query_cost_est_flops.labels(alg).inc(
+            snap["device"]["est_flops"])
+        METRICS.query_cost_est_hbm_bytes.labels(alg).inc(
+            snap["device"]["est_bytes_accessed"])
+        METRICS.query_cost_h2d_bytes.labels(alg).inc(snap["h2d"]["bytes"])
+        _ledger.note_completed(led)
 
     def _run_query(self) -> None:
         try:
@@ -329,8 +375,11 @@ class Job:
                                   warm_start=chunks > 1
                                   and hb.supports_warm_start,
                                   hop_callback=grab_shell)
+            b0 = _time.perf_counter()
             ranks, steps = _block_steps(
                 lambda: (np.asarray(ranks), steps))
+            self.ledger.add_phase("device_wait",
+                                  _time.perf_counter() - b0)
         except Exception as e:
             # a device failure mid-dispatch falls back to the
             # O(1)-memory-per-hop device-resident route (which rebuilds
@@ -357,6 +406,7 @@ class Job:
             METRICS.snapshot_build_seconds.observe(
                 fold_seconds / max(len(hops), 1))
         METRICS.supersteps.inc(max(steps, 0))
+        self.ledger.count_supersteps(steps)
         for j, T in enumerate(hops):
             if self._kill.is_set():
                 return
@@ -401,14 +451,18 @@ class Job:
 
         t0 = _time.perf_counter()
         _, cols = hb._fold_columns(hops, grab_shell)
+        self.ledger.add_phase("fold", hb.fold_seconds)
         if isinstance(hb, HopBatchedSSSP):
             *cols, kw["weight_cols"] = cols
         try:
             ranks, steps = run_columns_sharded(
                 hb.tables, *cols, hops, windows,
                 self.mesh.devices.ravel(), **kw)
+            b0 = _time.perf_counter()
             ranks, steps = _block_steps(
                 lambda: (np.asarray(ranks), steps))
+            self.ledger.add_phase("device_wait",
+                                  _time.perf_counter() - b0)
         except Exception as e:
             # replicating the tables can exhaust one chip's HBM on graphs
             # the host-side guard admits — fall through to the
@@ -454,6 +508,7 @@ class Job:
             s0 = _time.perf_counter()
             advance(int(t))
             METRICS.snapshot_build_seconds.observe(_time.perf_counter() - s0)
+            self.ledger.add_phase("fold", _time.perf_counter() - s0)
             windows = list(q.windows) if q.windows is not None else None
             result, steps = run(windows)
             rv = freeze_rv()
@@ -475,8 +530,11 @@ class Job:
         # now (the pipelined hop's fold) so _emit's end-to-end clock reads
         # dispatch-window + blocking tail only.
         t0 = t0 + (_time.perf_counter() - t_disp)
+        b0 = _time.perf_counter()
         _, steps = _block_steps(lambda: (None, steps))
+        self.ledger.add_phase("device_wait", _time.perf_counter() - b0)
         METRICS.supersteps.inc(max(steps, 0))
+        self.ledger.count_supersteps(steps)
         if q.windows is not None:
             for i, w in enumerate(q.windows):
                 r_i = jax.tree_util.tree_map(
@@ -517,11 +575,15 @@ class Job:
             s0 = _time.perf_counter()
             sweep.advance(int(t))
             METRICS.snapshot_build_seconds.observe(_time.perf_counter() - s0)
+            self.ledger.add_phase("fold", _time.perf_counter() - s0)
             windows = list(q.windows) if q.windows is not None else None
             result, steps = sweep.run(p, window=q.window, windows=windows)
             rv = _DeviceShell(sweep).freeze()
+            b0 = _time.perf_counter()
             result, steps = _block_steps(lambda: (
                 jax.tree_util.tree_map(np.asarray, result), steps))
+            self.ledger.add_phase("device_wait",
+                                  _time.perf_counter() - b0)
         except Exception as e:
             # device trouble mid-dispatch: a partially applied delta (or a
             # failed donated-buffer call) can leave the device state
@@ -535,6 +597,7 @@ class Job:
         finally:
             lock.release()
         METRICS.supersteps.inc(max(steps, 0))
+        self.ledger.count_supersteps(steps)
         if windows is not None:
             for i, w in enumerate(windows):
                 r_i = jax.tree_util.tree_map(lambda a: a[i], result)
@@ -555,13 +618,19 @@ class Job:
                 int(t), view, self.program.needs_occurrences,
                 version=sweep.log.version)
         else:
+            s0 = _time.perf_counter()
             view = self.graph.view_at(
                 int(t), exact=exact, wait_timeout=self.wait_timeout,
                 include_occurrences=self.program.needs_occurrences)
+        self.ledger.add_phase("fold", _time.perf_counter() - s0)
         windows = q.windows
+        c0 = _time.perf_counter()
         if windows is not None:
             result, steps = self._execute(view, windows=list(windows))
-            METRICS.supersteps.inc(max(int(steps), 0))  # once per device run
+            steps = int(steps)   # device barrier for the superstep count
+            self.ledger.add_phase("compute", _time.perf_counter() - c0)
+            METRICS.supersteps.inc(max(steps, 0))  # once per device run
+            self.ledger.count_supersteps(steps)
             for i, w in enumerate(windows):
                 import jax
 
@@ -569,7 +638,10 @@ class Job:
                 self._emit(t, w, r_i, view, steps, t0)
         else:
             result, steps = self._execute(view, window=q.window)
-            METRICS.supersteps.inc(max(int(steps), 0))
+            steps = int(steps)
+            self.ledger.add_phase("compute", _time.perf_counter() - c0)
+            METRICS.supersteps.inc(max(steps, 0))
+            self.ledger.count_supersteps(steps)
             self._emit(t, q.window, result, view, steps, t0)
 
     def _execute(self, view, window=None, windows=None):
@@ -581,11 +653,14 @@ class Job:
         return bsp.run(self.program, view, window=window, windows=windows)
 
     def _emit(self, t, window, result, view, steps, t0) -> None:
+        e0 = _time.perf_counter()
         reduced = self.program.reduce(result, view, window=window)
         # counted only after the host reduce: viewTime is END-TO-END (device
         # compute + reduce), and a failed reduce is not a computed view
         METRICS.views_computed.inc()
         METRICS.view_seconds.observe(_time.perf_counter() - t0)
+        self.ledger.add_phase("emit", _time.perf_counter() - e0)
+        self.ledger.count_views()
         row = {
             "time": int(t),
             "windowsize": int(window) if window is not None else None,
@@ -653,7 +728,8 @@ class AnalysisManager:
     def submit(self, program: VertexProgram, query: Query,
                job_id: str | None = None, mesh=None,
                wait_timeout: float = 30.0, sink_name: str | None = None,
-               sink_format: str | None = None) -> Job:
+               sink_format: str | None = None,
+               explain: bool = False) -> Job:
         from .sink import ResultSink, resolve_sink_path
 
         with self._lock:
@@ -663,7 +739,7 @@ class AnalysisManager:
                 raise KeyError(f"job {job_id!r} already exists")
             job = Job(job_id, program, query, self.graph,
                       mesh=mesh if mesh is not None else self.mesh,
-                      wait_timeout=wait_timeout)
+                      wait_timeout=wait_timeout, explain=explain)
             self._jobs[job_id] = job
         sink = None
         try:

@@ -10,10 +10,14 @@ repeatTime, rawFile) with `params` as an extension for hyperparameters.
 
 Operational extensions (no reference analogue — SURVEY §5.1 "No spans"):
 GET ``/healthz`` (liveness), ``/statusz`` (job table, watermarks, transfer
-stats, compile-cache sizes, flight-recorder state) and ``/tracez`` (recent
-spans; ``?n=``, ``?format=chrome`` for a full Chrome trace-event document,
-``?dump=1`` to write it to a server-side temp file, ``?enable=0|1`` to
-toggle tracing at runtime).
+stats, compile-cache sizes, flight-recorder + ledger state), ``/tracez``
+(recent spans; ``?n=``, ``?format=chrome`` for a full Chrome trace-event
+document, ``?dump=1`` to write it to a server-side temp file,
+``?enable=0|1`` to toggle tracing at runtime), and ``/costz`` (the cost
+ledger: per-kernel XLA cost/memory analysis with roofline classification
+plus recent per-query ledgers — docs/OBSERVABILITY.md "Cost ledger").
+POST bodies additionally accept ``explain`` (truthy): the job's resource
+ledger rides back with ``/AnalysisResults``.
 """
 
 from __future__ import annotations
@@ -23,6 +27,7 @@ import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..obs import ledger as _ledger
 from ..obs.trace import TRACER
 from . import registry
 from .manager import AnalysisManager, LiveQuery, RangeQuery, ViewQuery
@@ -82,6 +87,7 @@ def _statusz(manager: AnalysisManager) -> dict:
         "compile_caches": _compile_cache_sizes(),
         "fold_cache": _fold_cache_status(),
         "trace": TRACER.status(),
+        "ledger": _ledger.status_block(),
     }
     try:
         status["latest_time"] = int(g.latest_time)
@@ -149,11 +155,16 @@ class _Handler(BaseHTTPRequestHandler):
                               window, windows)
             # sinkName is a file name resolved INSIDE the server's
             # configured sink dir (jobs/sink.py) — absolute/escaping paths
-            # are rejected; with no sink dir configured it is ignored
+            # are rejected; with no sink dir configured it is ignored.
+            # explain=1 asks for the job's resource ledger back with the
+            # results (/AnalysisResults gains a "ledger" block).
+            explain = str(body.get("explain", "")).lower() \
+                in ("1", "true", "yes")
             job = self.manager.submit(
                 program, q, job_id=body.get("jobID"),
                 sink_name=body.get("sinkName"),
-                sink_format=body.get("sinkFormat"))
+                sink_format=body.get("sinkFormat"),
+                explain=explain)
             payload = {"jobID": job.id, "status": job.status}
             if job.sink is not None:
                 payload["sinkPath"] = job.sink.path
@@ -188,10 +199,13 @@ class _Handler(BaseHTTPRequestHandler):
             path = parsed.path.rstrip("/")
             if path == "/AnalysisResults":
                 job = self.manager.get(qs["jobID"][0])
-                return self._json(200, {
+                payload = {
                     "jobID": job.id, "status": job.status,
                     "error": job.error, "results": job.results,
-                })
+                }
+                if job.explain:
+                    payload["ledger"] = job.ledger.as_dict()
+                return self._json(200, payload)
             if path == "/KillTask":
                 self.manager.kill(qs["jobID"][0])
                 return self._json(200, {"jobID": qs["jobID"][0],
@@ -206,6 +220,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(200, _statusz(self.manager))
             if path == "/tracez":
                 return self._tracez(qs)
+            if path == "/costz":
+                # per-kernel harvested XLA cost/memory analysis with the
+                # roofline classification + recent per-query ledgers
+                return self._json(200, _ledger.costz())
             return self._json(404, {"error": f"unknown path {self.path}"})
         except KeyError as e:
             self._json(404, {"error": f"KeyError: {e}"})

@@ -7,6 +7,7 @@ layers, plus a JAX profiler hook for device traces (the capability Kamon's
 AspectJ weaver has no analogue for)."""
 
 from .trace import TRACER, Tracer, span   # stdlib-only — always available
+from .ledger import Ledger, REGISTRY, instrument   # stdlib-only (jax lazy)
 
 try:
     # metrics + device profiling need prometheus_client / jax, which
@@ -19,4 +20,5 @@ except ImportError:   # pragma: no cover — stripped environment
     device_trace = annotate = None
 
 __all__ = ["METRICS", "Metrics", "MetricsServer", "device_trace",
-           "annotate", "TRACER", "Tracer", "span"]
+           "annotate", "TRACER", "Tracer", "span",
+           "Ledger", "REGISTRY", "instrument"]

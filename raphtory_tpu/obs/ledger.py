@@ -1,0 +1,651 @@
+"""Per-query resource ledger + XLA kernel cost registry.
+
+The tracing layer (``obs/trace.py``) answers *when* time goes and the
+fold metrics answer *one* subsystem; nothing could answer "what did this
+query cost, which resource is it bound on, and did HEAD regress?" — the
+accounting the serving scheduler (admission control sized by measured
+cost) and the PCPM kernel work (per-kernel HBM-bytes evidence that the
+hop kernels are gather-bound, arXiv:1709.07122) both block on. Three
+pieces:
+
+* **Ledger** — a per-query accumulator every job carries: phase seconds
+  (fold/stage/ship/compute from the sweep engines' phase breakdowns,
+  plus device_wait / emit / other measured by the jobs layer), fold
+  seconds by mode and fold-cache hits, H2D bytes + stall seconds
+  (TransferEngine deltas), per-kernel device dispatch counts with
+  estimated FLOPs / bytes-accessed, queue wait, and peak host RSS.
+  Jobs accept ``explain=1`` and return it with the result; the phase
+  seconds (queue wait included) sum to the job's wall time by
+  construction (``other`` is the explicit residual).
+* **KernelRegistry** — process-wide: every compiled kernel the engines
+  dispatch is registered by ``instrument()``, and ONCE per (kernel,
+  argument-shape signature) the XLA ``cost_analysis()`` (FLOPs, bytes
+  accessed) and ``memory_analysis()`` (temp/argument/output bytes) are
+  harvested at compile time through the AOT ``lower().compile()`` path —
+  which shares the in-memory XLA compilation cache with the normal
+  dispatch path, so the harvest costs executable-load time, not a second
+  compile. Each kernel is classified roofline-style from its arithmetic
+  intensity (FLOPs per byte accessed) against the backend's ridge point.
+* **Capability probes** — ``cost_analysis``/``memory_analysis`` may
+  return None or raise on some backends/jaxlib versions; the probe runs
+  once, harvesting never propagates an exception, and the ledger
+  degrades to host-side accounting (kernels report ``bound="unknown"``)
+  rather than ever failing a sweep.
+
+Roofline classification rule (documented in docs/OBSERVABILITY.md):
+``intensity = flops / bytes_accessed``; a kernel is ``hbm_bound`` when
+intensity is below the backend ridge (peak FLOP/s ÷ peak memory
+bandwidth), else ``compute_bound``. The query-level ``bound`` is
+``host_bound`` / ``h2d_bound`` when the fold / ship phase dominates wall
+time, else the dominant kernel's roofline bound.
+
+Knobs
+-----
+* ``RTPU_LEDGER`` — per-query cost accounting (default on; ``0``
+  disables collection, the bench A/B arm).
+* ``RTPU_LEDGER_XLA`` — compile-time XLA cost/memory harvest (default
+  on; ``0`` forces host-side-only accounting).
+* ``RTPU_LEDGER_RIDGE`` — override the roofline ridge point
+  (flops/byte) when the built-in per-backend operating points are wrong
+  for the hardware.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import resource
+import sys
+import threading
+import time
+
+from .trace import TRACER
+
+#: (peak FLOP/s, peak memory bandwidth B/s) operating points per backend —
+#: order-of-magnitude roofline anchors, not measured calibration (the
+#: TPU row matches bench.py's v5e-class constants). Override the derived
+#: ridge with RTPU_LEDGER_RIDGE.
+_PEAKS = {
+    "tpu": (197e12, 819e9),     # v5e-class bf16 peak / HBM bandwidth
+    "gpu": (1e14, 2e12),
+    "cpu": (1e11, 2e10),        # few-core container class
+}
+_DEFAULT_PLATFORM = "cpu"
+
+
+def _enabled() -> bool:
+    """Collection gate, re-read per call so the bench A/B (and operators)
+    can flip ``RTPU_LEDGER`` without a restart."""
+    return os.environ.get("RTPU_LEDGER", "1") not in ("", "0", "false")
+
+
+def collection_enabled() -> bool:
+    """Public alias of the ``RTPU_LEDGER`` gate — the jobs layer checks
+    it before publishing (metrics, /costz ring, instants), so disabling
+    collection silences every ledger surface."""
+    return _enabled()
+
+
+def _xla_enabled() -> bool:
+    return os.environ.get("RTPU_LEDGER_XLA", "1") not in ("", "0", "false")
+
+
+def _rss_peak_bytes() -> int:
+    """Lifetime peak RSS (ru_maxrss is KiB on Linux, bytes on macOS) —
+    stdlib-only so the ledger imports in stripped environments."""
+    try:
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(peak if sys.platform == "darwin" else peak * 1024)
+    except Exception:
+        return 0
+
+
+# --------------------------------------------------------------- XLA caps
+
+_CAPS: dict = {}
+_CAPS_LOCK = threading.Lock()
+
+
+def _cost_dict(compiled):
+    """Tolerant ``cost_analysis()`` extraction: older jaxlibs return a
+    one-element list of dicts, newer ones a dict; either may be None."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return ca if isinstance(ca, dict) else None
+
+
+def xla_analysis_caps() -> dict:
+    """Probe-once capability check for compile-time cost/memory harvest.
+    On backends/jaxlib versions where the analyses raise or return None
+    the ledger degrades to host-side accounting — a sweep must never fail
+    because its accounting layer couldn't introspect the executable."""
+    with _CAPS_LOCK:
+        if _CAPS:
+            return dict(_CAPS)
+    caps = {"cost": False, "memory": False,
+            "platform": _DEFAULT_PLATFORM, "probed": True}
+    if _xla_enabled():
+        try:
+            import jax
+
+            caps["platform"] = jax.devices()[0].platform
+            fn = jax.jit(lambda x: x * 2.0 + 1.0)
+            comp = fn.lower(
+                jax.ShapeDtypeStruct((8,), "float32")).compile()
+            ca = _cost_dict(comp)
+            caps["cost"] = ca is not None and "flops" in ca
+            ma = comp.memory_analysis()
+            caps["memory"] = (ma is not None
+                              and hasattr(ma, "temp_size_in_bytes"))
+        except Exception as e:   # probe failure == capability absent
+            caps["error"] = f"{type(e).__name__}: {e}"[:200]
+    else:
+        caps["disabled"] = True
+    with _CAPS_LOCK:
+        _CAPS.clear()
+        _CAPS.update(caps)
+    return dict(caps)
+
+
+def reset_xla_caps() -> None:
+    """Forget the probe result (tests flip RTPU_LEDGER_XLA and re-probe)."""
+    with _CAPS_LOCK:
+        _CAPS.clear()
+
+
+def ridge_flops_per_byte(platform: str | None = None) -> float:
+    """Roofline ridge point for ``platform`` (default: the probed one)."""
+    v = os.environ.get("RTPU_LEDGER_RIDGE")
+    if v is not None:
+        try:
+            return max(1e-6, float(v))
+        except ValueError:
+            pass
+    if platform is None:
+        platform = xla_analysis_caps().get("platform", _DEFAULT_PLATFORM)
+    flops, bw = _PEAKS.get(platform, _PEAKS[_DEFAULT_PLATFORM])
+    return flops / bw
+
+
+def classify_roofline(flops, bytes_accessed,
+                      platform: str | None = None) -> str:
+    """``hbm_bound`` | ``compute_bound`` | ``unknown`` from harvested
+    cost-analysis numbers — the ONE place the classification rule lives
+    (docs/OBSERVABILITY.md documents it verbatim)."""
+    if not flops or not bytes_accessed:
+        return "unknown"
+    intensity = float(flops) / float(bytes_accessed)
+    return ("compute_bound"
+            if intensity >= ridge_flops_per_byte(platform) else "hbm_bound")
+
+
+# --------------------------------------------------------- kernel registry
+
+
+def _sig_of(args) -> tuple:
+    """Cheap argument-shape signature: shape+dtype for array-likes (never
+    materialises device data), type name for python scalars."""
+    sig = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dt = getattr(a, "dtype", None)
+        if shape is not None and dt is not None:
+            sig.append(f"{dt}{list(shape)}")
+        else:
+            sig.append(f"py:{type(a).__name__}")
+    return tuple(sig)
+
+
+class KernelRegistry:
+    """Process-wide registry of every compiled kernel the engines
+    dispatch: one record per (kernel name, argument-shape signature),
+    carrying harvested XLA cost/memory analysis, the roofline
+    classification, and lifetime dispatch counts."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._kernels: dict[tuple, dict] = {}
+
+    def _ensure(self, name: str, sig: tuple) -> dict:
+        key = (name, sig)
+        with self._lock:
+            rec = self._kernels.get(key)
+            if rec is None:
+                rec = {
+                    "kernel": name, "sig": "×".join(sig),
+                    "dispatches": 0, "mode": "host", "bound": "unknown",
+                    "flops": None, "bytes_accessed": None,
+                    "temp_bytes": None, "argument_bytes": None,
+                    "output_bytes": None, "intensity": None,
+                }
+                self._kernels[key] = rec
+            return rec
+
+    def harvest(self, name: str, sig: tuple, fn, args) -> dict:
+        """Harvest ``cost_analysis``/``memory_analysis`` for one compiled
+        (kernel, shapes) through the AOT path — BEFORE the dispatch call,
+        so donated buffers are still alive for tracing. Never raises:
+        any failure leaves the record in host-side mode."""
+        rec = self._ensure(name, sig)
+        caps = xla_analysis_caps()
+        if not (caps["cost"] or caps["memory"]):
+            return rec
+        try:
+            t0 = time.perf_counter()
+            compiled = fn.lower(*args).compile()
+            harvest_s = time.perf_counter() - t0
+            updates: dict = {"mode": "xla",
+                             "harvest_seconds": round(harvest_s, 4)}
+            if caps["cost"]:
+                ca = _cost_dict(compiled)
+                if ca is not None:
+                    updates["flops"] = float(ca.get("flops") or 0.0)
+                    updates["bytes_accessed"] = float(
+                        ca.get("bytes accessed") or 0.0)
+            if caps["memory"]:
+                ma = compiled.memory_analysis()
+                if ma is not None:
+                    updates["temp_bytes"] = int(ma.temp_size_in_bytes)
+                    updates["argument_bytes"] = int(
+                        ma.argument_size_in_bytes)
+                    updates["output_bytes"] = int(ma.output_size_in_bytes)
+            flops = updates.get("flops")
+            nbytes = updates.get("bytes_accessed")
+            if flops and nbytes:
+                updates["intensity"] = round(flops / nbytes, 4)
+            updates["bound"] = classify_roofline(flops, nbytes,
+                                                 caps.get("platform"))
+            with self._lock:
+                rec.update(updates)
+            TRACER.instant("ledger.kernel", kernel=name,
+                           bound=rec["bound"], flops=rec["flops"],
+                           bytes_accessed=rec["bytes_accessed"])
+        except Exception as e:   # harvest must never fail a sweep
+            with self._lock:
+                rec["harvest_error"] = f"{type(e).__name__}: {e}"[:200]
+        return rec
+
+    def note_dispatch(self, name: str, sig: tuple) -> dict:
+        rec = self._ensure(name, sig)
+        with self._lock:
+            rec["dispatches"] += 1
+        return rec
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in self._kernels.values()]
+
+    @staticmethod
+    def bound_counts(records: list[dict]) -> dict:
+        """Kernel count per roofline bound over an ALREADY-TAKEN
+        ``snapshot()`` — so /statusz and /costz copy the table once."""
+        out: dict[str, int] = {}
+        for rec in records:
+            out[rec["bound"]] = out.get(rec["bound"], 0) + 1
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._kernels.clear()
+
+
+#: the process singleton every instrumented engine records into
+REGISTRY = KernelRegistry()
+
+
+class InstrumentedKernel:
+    """Wrapper the engine compiled-program caches return: dispatch goes
+    straight through to the jitted callable (donation, async dispatch and
+    the C++ fast path untouched), while the wrapper counts the dispatch
+    into the registry and the active query ledger, and harvests XLA
+    analysis once per argument-shape signature. With ``RTPU_LEDGER=0``
+    the wrapper is a single env-read passthrough."""
+
+    __slots__ = ("name", "fn", "_seen", "_lock")
+
+    def __init__(self, name: str, fn):
+        self.name = name
+        self.fn = fn
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    def __call__(self, *args):
+        if not _enabled():
+            return self.fn(*args)
+        sig = _sig_of(args)
+        with self._lock:
+            fresh = sig not in self._seen
+            if fresh:
+                self._seen.add(sig)
+        if fresh:
+            # BEFORE the dispatch: donated buffers must still be alive
+            # when lower() traces; the AOT compile lands in (or seeds)
+            # the same in-memory XLA cache the call below hits
+            REGISTRY.harvest(self.name, sig, self.fn, args)
+        out = self.fn(*args)
+        rec = REGISTRY.note_dispatch(self.name, sig)
+        led = current()
+        if led is not None:
+            led.count_dispatch(self.name, rec)
+        return out
+
+    # the REST compile-cache introspection walks factories; keep the
+    # wrapped callable reachable for debugging
+    def __repr__(self):
+        return f"InstrumentedKernel({self.name!r})"
+
+
+def instrument(name: str, fn) -> InstrumentedKernel:
+    """Wrap a jitted callable for the kernel registry — what every
+    compiled-program cache in ``engine/`` returns."""
+    return InstrumentedKernel(name, fn)
+
+
+# ---------------------------------------------------------------- ledger
+
+
+class Ledger:
+    """Per-query resource accumulator — thread-safe (fold workers and the
+    dispatch thread may record concurrently). ``merge()`` folds another
+    ledger's accounting in; no product driver uses it yet (the parallel
+    fold units report through the engines' own per-run accounting, which
+    ``add_sweep`` ingests) — it exists, tested, for the serving-scheduler
+    tentpole whose cross-tenant batches will need sub-ledgers."""
+
+    def __init__(self, query_id: str = "", algorithm: str = ""):
+        self._lock = threading.Lock()
+        self.query_id = query_id
+        self.algorithm = algorithm
+        self.created_unix = time.time()
+        self.queue_wait_seconds = 0.0
+        self.wall_seconds = 0.0
+        self.status = "running"
+        self.phase_seconds: dict[str, float] = {}
+        self.fold_mode_seconds: dict[str, float] = {}
+        self.fold_cache_hits = 0
+        self.fold_cache_misses = 0
+        self.h2d_bytes = 0
+        self.h2d_stall_seconds: dict[str, float] = {}
+        self.kernels: dict[str, dict] = {}
+        self.sweeps = 0
+        self.views = 0
+        self.supersteps = 0
+        self.hops = 0
+        self.peak_rss_bytes = 0
+
+    # ---- recording ----
+
+    def add_phase(self, phase: str, seconds: float) -> None:
+        with self._lock:
+            self.phase_seconds[phase] = (
+                self.phase_seconds.get(phase, 0.0) + float(seconds))
+
+    def fold_cache_event(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.fold_cache_hits += 1
+            else:
+                self.fold_cache_misses += 1
+
+    def add_sweep(self, phases: dict, ship_delta: dict, ship_bytes: int,
+                  n_hops: int, fold_modes: dict | None = None) -> None:
+        """One sweep's phase breakdown (``sweep_phase_summary`` output) +
+        transfer-engine deltas — called by both sweep engines on the
+        dispatch thread."""
+        with self._lock:
+            for ph, sec in phases.items():
+                self.phase_seconds[ph] = (
+                    self.phase_seconds.get(ph, 0.0) + float(sec))
+            self.h2d_bytes += int(ship_delta.get("bytes_shipped", 0) or 0)
+            for stage in ("stage", "wire"):
+                sec = float(ship_delta.get(f"{stage}_stall_seconds", 0.0)
+                            or 0.0)
+                if sec:
+                    self.h2d_stall_seconds[stage] = (
+                        self.h2d_stall_seconds.get(stage, 0.0) + sec)
+            self.sweeps += 1
+            self.hops += int(n_hops)
+            if fold_modes:
+                for mode, sec in fold_modes.items():
+                    self.fold_mode_seconds[mode] = (
+                        self.fold_mode_seconds.get(mode, 0.0) + float(sec))
+
+    def count_dispatch(self, name: str, rec: dict) -> None:
+        with self._lock:
+            k = self.kernels.get(name)
+            if k is None:
+                k = self.kernels[name] = {
+                    "dispatches": 0, "est_flops": 0.0,
+                    "est_bytes_accessed": 0.0, "bound": "unknown"}
+            k["dispatches"] += 1
+            k["est_flops"] += float(rec.get("flops") or 0.0)
+            k["est_bytes_accessed"] += float(
+                rec.get("bytes_accessed") or 0.0)
+            k["bound"] = rec.get("bound", "unknown")
+
+    def count_views(self, n: int = 1) -> None:
+        with self._lock:
+            self.views += int(n)
+
+    def count_supersteps(self, n: int) -> None:
+        with self._lock:
+            self.supersteps += max(0, int(n))
+
+    def merge(self, other: "Ledger") -> "Ledger":
+        """Fold ``other``'s accounting into this ledger (parallel fold
+        workers / sub-unit ledgers). Scalar maxima (peak RSS) take the
+        max; everything else sums."""
+        with other._lock:
+            snap = other._unlocked_dict()
+        with self._lock:
+            for ph, sec in snap["phase_seconds"].items():
+                self.phase_seconds[ph] = (
+                    self.phase_seconds.get(ph, 0.0) + sec)
+            for mode, sec in snap["fold"]["seconds_by_mode"].items():
+                self.fold_mode_seconds[mode] = (
+                    self.fold_mode_seconds.get(mode, 0.0) + sec)
+            self.fold_cache_hits += snap["fold"]["cache_hits"]
+            self.fold_cache_misses += snap["fold"]["cache_misses"]
+            self.h2d_bytes += snap["h2d"]["bytes"]
+            for stage, sec in snap["h2d"]["stall_seconds"].items():
+                self.h2d_stall_seconds[stage] = (
+                    self.h2d_stall_seconds.get(stage, 0.0) + sec)
+            for name, k in snap["device"]["kernels"].items():
+                mine = self.kernels.get(name)
+                if mine is None:
+                    self.kernels[name] = dict(k)
+                else:
+                    mine["dispatches"] += k["dispatches"]
+                    mine["est_flops"] += k["est_flops"]
+                    mine["est_bytes_accessed"] += k["est_bytes_accessed"]
+            self.sweeps += snap["sweeps"]
+            self.views += snap["views"]
+            self.supersteps += snap["supersteps"]
+            self.hops += snap["hops"]
+            self.peak_rss_bytes = max(self.peak_rss_bytes,
+                                      snap["host"]["peak_rss_bytes"])
+        return self
+
+    def finish(self, wall_seconds: float, status: str = "done") -> None:
+        """Close the ledger: record wall time, peak RSS, and the explicit
+        ``other`` residual phase so queue wait + phase seconds sum to the
+        wall time exactly — the invariant /costz consumers rely on."""
+        with self._lock:
+            self.wall_seconds = float(wall_seconds)
+            self.status = status
+            self.peak_rss_bytes = max(self.peak_rss_bytes,
+                                      _rss_peak_bytes())
+            known = sum(self.phase_seconds.values())
+            self.phase_seconds["other"] = max(
+                0.0, self.wall_seconds - self.queue_wait_seconds - known)
+
+    # ---- classification / export ----
+
+    def bound(self) -> str:
+        """Query-level resource verdict: host_bound when the fold phase
+        dominates, h2d_bound when staging/shipping does, else the
+        dominant kernel's roofline bound (docs/OBSERVABILITY.md)."""
+        with self._lock:
+            ph = dict(self.phase_seconds)
+            kernels = {n: dict(k) for n, k in self.kernels.items()}
+        host = ph.get("fold", 0.0)
+        h2d = ph.get("stage", 0.0) + ph.get("ship", 0.0)
+        dev = (ph.get("compute", 0.0) + ph.get("device_wait", 0.0))
+        top = max((host, h2d, dev))
+        if top <= 0.0:
+            return "unknown"
+        if top == host:
+            return "host_bound"
+        if top == h2d:
+            return "h2d_bound"
+        if kernels:
+            dom = max(kernels.values(),
+                      key=lambda k: k["est_bytes_accessed"])
+            if dom["bound"] != "unknown":
+                return dom["bound"]
+        return "unknown"
+
+    def _unlocked_dict(self) -> dict:
+        return {
+            "query_id": self.query_id,
+            "algorithm": self.algorithm,
+            "status": self.status,
+            "queue_wait_seconds": round(self.queue_wait_seconds, 6),
+            "wall_seconds": round(self.wall_seconds, 6),
+            "phase_seconds": {ph: round(s, 6)
+                              for ph, s in self.phase_seconds.items()},
+            "fold": {
+                "seconds_by_mode": {m: round(s, 6) for m, s in
+                                    self.fold_mode_seconds.items()},
+                "cache_hits": self.fold_cache_hits,
+                "cache_misses": self.fold_cache_misses,
+            },
+            "h2d": {"bytes": int(self.h2d_bytes),
+                    "stall_seconds": {s: round(v, 6) for s, v in
+                                      self.h2d_stall_seconds.items()}},
+            "device": {
+                "dispatches": sum(k["dispatches"]
+                                  for k in self.kernels.values()),
+                "est_flops": sum(k["est_flops"]
+                                 for k in self.kernels.values()),
+                "est_bytes_accessed": sum(k["est_bytes_accessed"]
+                                          for k in self.kernels.values()),
+                "kernels": {n: dict(k) for n, k in self.kernels.items()},
+            },
+            "host": {"peak_rss_bytes": int(self.peak_rss_bytes)},
+            "sweeps": self.sweeps,
+            "views": self.views,
+            "supersteps": self.supersteps,
+            "hops": self.hops,
+        }
+
+    def as_dict(self) -> dict:
+        out_bound = self.bound()
+        with _CAPS_LOCK:
+            caps = dict(_CAPS) if _CAPS else {"probed": False}
+        with self._lock:
+            out = self._unlocked_dict()
+        out["bound"] = out_bound
+        out["xla_analysis"] = ("harvested"
+                               if caps.get("cost") or caps.get("memory")
+                               else "host_only")
+        return out
+
+
+# ------------------------------------------------------ activation context
+
+_ACTIVE = threading.local()
+
+
+@contextlib.contextmanager
+def activate(ledger: Ledger):
+    """Bind ``ledger`` as THIS thread's active query ledger — engine
+    layers attribute dispatches/phases to ``current()``. Thread-local by
+    design: two concurrent jobs on different threads never share one."""
+    prev = getattr(_ACTIVE, "ledger", None)
+    _ACTIVE.ledger = ledger
+    try:
+        yield ledger
+    finally:
+        _ACTIVE.ledger = prev
+
+
+def current() -> Ledger | None:
+    """The active query ledger of THIS thread (None when collection is
+    off or no query is in flight) — every engine-side hook goes through
+    here, so a disabled ledger costs one env read + one getattr."""
+    if not _enabled():
+        return None
+    return getattr(_ACTIVE, "ledger", None)
+
+
+# -------------------------------------------------- completed-query ring
+
+_RECENT: collections.deque = collections.deque(maxlen=64)
+_RECENT_LOCK = threading.Lock()
+_COMPLETED = [0]
+
+
+def note_completed(ledger: Ledger) -> None:
+    """Record a finished query's ledger into the bounded ring /costz
+    serves, and drop a flight-recorder instant so the cost lands on the
+    trace timeline next to the spans it explains."""
+    snap = ledger.as_dict()
+    with _RECENT_LOCK:
+        _RECENT.append(snap)
+        _COMPLETED[0] += 1
+    TRACER.instant(
+        "ledger.query", query_id=snap["query_id"],
+        algorithm=snap["algorithm"], bound=snap["bound"],
+        wall_seconds=snap["wall_seconds"],
+        est_flops=snap["device"]["est_flops"],
+        est_bytes_accessed=snap["device"]["est_bytes_accessed"],
+        h2d_bytes=snap["h2d"]["bytes"])
+
+
+def recent_queries(n: int = 16) -> list[dict]:
+    with _RECENT_LOCK:
+        snap = list(_RECENT)
+    return snap[-max(0, int(n)):]
+
+
+# ------------------------------------------------------------- surfaces
+
+
+def status_block() -> dict:
+    """The compact ``ledger`` block /statusz embeds."""
+    with _CAPS_LOCK:
+        caps = dict(_CAPS) if _CAPS else {"probed": False}
+    kernels = REGISTRY.snapshot()
+    return {
+        "enabled": _enabled(),
+        "xla": caps,
+        "kernels": len(kernels),
+        "kernels_by_bound": KernelRegistry.bound_counts(kernels),
+        "queries_completed": _COMPLETED[0],
+    }
+
+
+def costz() -> dict:
+    """The full /costz payload: probed capabilities, the roofline ridge,
+    every registered kernel with its harvested analysis + classification,
+    and the recent completed-query ledgers."""
+    caps = xla_analysis_caps()
+    kernels = sorted(REGISTRY.snapshot(),
+                     key=lambda r: -(r["bytes_accessed"] or 0.0)
+                     * r["dispatches"])
+    return {
+        "enabled": _enabled(),
+        "xla": caps,
+        "ridge_flops_per_byte": round(
+            ridge_flops_per_byte(caps.get("platform")), 3),
+        "classification_rule": (
+            "intensity = flops / bytes_accessed; hbm_bound if intensity "
+            "< ridge else compute_bound; unknown without harvested "
+            "analysis"),
+        "kernels": kernels,
+        "kernels_by_bound": KernelRegistry.bound_counts(kernels),
+        "recent_queries": recent_queries(),
+    }

@@ -133,6 +133,33 @@ class Metrics:
             "in-flight waits, compute=dispatch-loop residual incl. device "
             "compute) — the phase breakdown the span tracer also attaches "
             "to every sweep span", ["phase"], registry=r)
+        # per-query resource ledger (obs/ledger.py): what a query COST,
+        # by algorithm — the accounting admission control and the PCPM
+        # kernel work size themselves from
+        self.query_cost_seconds = Histogram(
+            "raphtory_query_cost_seconds",
+            "Per-query wall seconds by ledger phase (fold/stage/ship/"
+            "compute from the sweep engines, device_wait/emit/other from "
+            "the jobs layer, queue_wait before the job thread ran)",
+            ["algorithm", "phase"], registry=r)
+        self.query_cost_queries = Counter(
+            "raphtory_query_cost_queries_total",
+            "Queries whose ledger was closed", ["algorithm", "bound"],
+            registry=r)
+        self.query_cost_est_flops = Counter(
+            "raphtory_query_cost_est_device_flops_total",
+            "Estimated device FLOPs attributed to queries (XLA "
+            "cost_analysis per compiled kernel x dispatch count)",
+            ["algorithm"], registry=r)
+        self.query_cost_est_hbm_bytes = Counter(
+            "raphtory_query_cost_est_hbm_bytes_total",
+            "Estimated device bytes accessed attributed to queries (XLA "
+            "cost_analysis bytes-accessed x dispatch count)",
+            ["algorithm"], registry=r)
+        self.query_cost_h2d_bytes = Counter(
+            "raphtory_query_cost_h2d_bytes_total",
+            "Host->device bytes attributed to queries (TransferEngine "
+            "deltas per sweep)", ["algorithm"], registry=r)
         # memory governor (Archivist signals)
         self.compactions = Counter(
             "raphtory_compactions_total",

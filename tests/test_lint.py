@@ -251,6 +251,30 @@ def test_use_after_donate_via_factory_flagged():
     assert "use-after-donate" in rules_of(fs)
 
 
+def test_use_after_donate_via_instrumented_factory_flagged():
+    # PR 6 idiom: the factory wraps the donating jit in the ledger's
+    # instrument() — the wrapper dispatches through, so donation (and
+    # this rule) must see through it
+    fs = lint("""
+        import functools
+        import jax
+        from raphtory_tpu.obs import ledger as _ledger
+
+        @functools.lru_cache(maxsize=8)
+        def compiled():
+            def apply(a, b):
+                return a + b
+            return _ledger.instrument(
+                "k", jax.jit(apply, donate_argnums=(0,)))
+
+        def step(state, delta):
+            fn = compiled()
+            out = fn(state, delta)
+            return out + state
+    """)
+    assert "use-after-donate" in rules_of(fs)
+
+
 def test_use_after_donate_suppressed():
     fs = lint(RT004_POSITIVE.replace(
         "return out + state",

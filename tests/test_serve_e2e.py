@@ -117,3 +117,73 @@ def test_repeat_views_and_metrics(node):
     ).read().decode()
     assert "raphtory_ingest_backlog_events" in text
     assert "raphtory_views_computed_total" in text
+
+
+def test_explain_range_job_returns_ledger_and_costz(node):
+    """explain=1 round trip (ISSUE 6 acceptance): the REST range job's
+    ledger comes back with the results, its queue-wait + phase seconds
+    sum to within 5% of the job's wall time, and /costz classifies hop
+    kernels from harvested XLA cost analysis (bound stays 'unknown' only
+    when the backend's capability probe reports no analysis support —
+    the tested CPU-fallback degradation)."""
+    out = _post(node["rest"], "/RangeAnalysisRequest", {
+        "analyserName": "PageRank", "start": 200, "end": 1000, "jump": 200,
+        "windowType": "single", "windowSize": 500,
+        "jobID": "e2e_explain", "explain": 1,
+        "params": {"max_steps": 10}})
+    assert out["jobID"] == "e2e_explain"
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        res = _get(node["rest"], "/AnalysisResults?jobID=e2e_explain")
+        if res["status"] in ("done", "failed"):
+            break
+        time.sleep(0.5)
+    assert res["status"] == "done", res["error"]
+    led = res["ledger"]
+    # schema: the documented blocks are all present
+    for key in ("query_id", "algorithm", "queue_wait_seconds",
+                "wall_seconds", "phase_seconds", "fold", "h2d", "device",
+                "host", "bound", "xla_analysis"):
+        assert key in led, f"ledger missing {key!r}"
+    assert led["query_id"] == "e2e_explain"
+    assert led["algorithm"] == "PageRank"
+    assert led["views"] == len(res["results"])
+    # the invariant /costz consumers rely on: queue wait + phases == wall
+    total = led["queue_wait_seconds"] + sum(led["phase_seconds"].values())
+    assert abs(total - led["wall_seconds"]) <= \
+        0.05 * led["wall_seconds"] + 1e-6
+    assert led["device"]["dispatches"] >= 1
+    assert led["host"]["peak_rss_bytes"] > 0
+
+    # a job without explain must NOT leak a ledger block
+    _post(node["rest"], "/ViewAnalysisRequest", {
+        "analyserName": "PageRank", "timestamp": 900,
+        "jobID": "e2e_noexplain", "params": {"max_steps": 5}})
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        res_plain = _get(node["rest"],
+                         "/AnalysisResults?jobID=e2e_noexplain")
+        if res_plain["status"] in ("done", "failed"):
+            break
+        time.sleep(0.3)
+    assert "ledger" not in res_plain
+
+    # /costz: kernel registry + roofline classification
+    cz = _get(node["rest"], "/costz")
+    assert cz["enabled"] and cz["kernels"], cz
+    names = {k["kernel"] for k in cz["kernels"]}
+    assert any(n.startswith(("hopbatch.", "device_sweep.", "bsp."))
+               for n in names)
+    if cz["xla"]["cost"]:
+        # harvested analysis present: at least one hop kernel classified
+        assert any(k["bound"] in ("hbm_bound", "compute_bound")
+                   for k in cz["kernels"]), cz["kernels"]
+    else:   # degraded host-side mode: classification honestly unknown
+        assert all(k["bound"] == "unknown" for k in cz["kernels"])
+    assert any(q["query_id"] == "e2e_explain"
+               for q in cz["recent_queries"])
+
+    # /statusz grew the compact ledger block
+    sz = _get(node["rest"], "/statusz")
+    assert sz["ledger"]["kernels"] >= 1
+    assert sz["ledger"]["queries_completed"] >= 1
