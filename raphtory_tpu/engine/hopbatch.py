@@ -113,6 +113,16 @@ def _tile_budget_bytes() -> int:
     return int(os.environ.get("RTPU_TILE_BUDGET_MB", 256)) << 20
 
 
+def _traffic(m_pad: int, C: int, n_pad: int, spec) -> dict:
+    """Engine-side DRAM traffic model of one message-combine superstep
+    (``ops/partition.edge_traffic_model``) — attached to every compiled
+    columnar kernel so the ledger can report partition-aware est HBM
+    bytes next to the locality-blind XLA ``bytes_accessed`` harvest."""
+    from ..ops.partition import edge_traffic_model
+
+    return edge_traffic_model(m_pad, C, n_pad, spec)
+
+
 def _edge_tile_for(m_pad: int, C: int, budget_bytes: int) -> int | None:
     """Edge-tile length for the columnar kernels, or None for single-shot.
 
@@ -142,7 +152,7 @@ def _edge_tile_for(m_pad: int, C: int, budget_bytes: int) -> int | None:
 
 def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
                       tol: float, max_steps: int, r_init=None,
-                      tile_budget: int | None = None):
+                      tile_budget: int | None = None, pcpm=None):
     """Power iteration over per-column masks ``me [m_pad, C]`` /
     ``mv [n_pad, C]`` — dangling redistribution, tol halting with
     converged-column freeze; semantics of ``algorithms/pagerank.py``.
@@ -154,8 +164,19 @@ def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
     SAME fixed point — a near-solution (the previous hop's ranks) just
     gets there in a few steps instead of max_steps. Each column is masked
     to its own alive set, floored so newly-alive vertices get mass, and
-    renormalised."""
+    renormalised.
+
+    ``pcpm`` = ``(spec, slot, u_src)`` switches the edge operands to the
+    destination-binned layout (``ops/partition.py``): ``me``/``e_src``/
+    ``e_dst`` are then the BINNED ``[B(, C)]`` arrays (ids stay global, so
+    every reduce keeps its shape) and the superstep gather goes through
+    the per-(partition, src) pre-aggregation buckets. Binned edges are
+    (partition, src)-ordered, so destination ids are NOT sorted — the
+    scatter instead stays inside one cache-resident partition slice
+    (docs/KERNELS.md). Float sums reorder: results agree to reduction
+    tolerance, not bitwise."""
     C = me.shape[1]
+    dst_sorted = pcpm is None
     # Edge traffic is tiled past the payload budget (_edge_tile_for): the
     # f32 view of the mask and the per-iteration gather payload are both
     # [m_pad, C] transients that at 28M pairs x 128 columns outgrow a
@@ -180,15 +201,17 @@ def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
                     payload_of(es, mk), ed if by_dst else es,
                     num_segments=n_pad,
                     # tiles are contiguous slices of the globally
-                    # (dst, src)-sorted order
-                    indices_are_sorted=by_dst), None
+                    # (dst, src)-sorted order — UNLESS binned, whose
+                    # (partition, src) order leaves dst unsorted
+                    indices_are_sorted=by_dst and dst_sorted), None
 
             acc, _ = jax.lax.scan(step, acc0, main)
             if rem[0].shape[0]:
                 es, ed, mk = rem
                 acc = acc + jax.ops.segment_sum(
                     payload_of(es, mk), ed if by_dst else es,
-                    num_segments=n_pad, indices_are_sorted=by_dst)
+                    num_segments=n_pad,
+                    indices_are_sorted=by_dst and dst_sorted)
             return acc
 
         out_deg = tiled_sum(
@@ -215,12 +238,23 @@ def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
         if tile is not None:
             agg = tiled_sum(
                 lambda es, mk: jnp.where(mk, rd[es, :], 0.0), by_dst=True)
+        elif pcpm is not None and pcpm[0].preagg:
+            # PCPM two-level gather: one state row per (partition, src)
+            # bucket — each source read ONCE per partition it reaches —
+            # then a streaming expansion through the resident bucket
+            spec, slot, u_src = pcpm
+            vals = rd[u_src, :]                       # [P*cap_u, C]
+            payload = jnp.where(me, vals[slot, :], 0.0)
+            agg = jax.ops.segment_sum(
+                payload, e_dst, num_segments=n_pad,
+                indices_are_sorted=False)
         else:
             # row gather [m, C]; the bool mask gates via where — only the
             # bool mask stays live across the loop
             payload = jnp.where(me, rd[e_src, :], 0.0)
             agg = jax.ops.segment_sum(
-                payload, e_dst, num_segments=n_pad, indices_are_sorted=True)
+                payload, e_dst, num_segments=n_pad,
+                indices_are_sorted=dst_sorted)
         dangling = jnp.sum(jnp.where(dangling_mask, r, 0.0), axis=0)
         new = ((1.0 - damping) / n_act[None, :]
                + damping * (agg + dangling[None, :] / n_act[None, :]))
@@ -245,25 +279,40 @@ def _pagerank_columns(me, mv, e_src, e_dst, n_pad: int, damping: float,
     return r.T, steps   # [C, n_pad], hop-major columns
 
 
+def _bin_masks(me, pcpm_args):
+    """Host-column edge masks → the binned layout, in-program: one
+    loop-invariant permutation gather, amortised over the supersteps.
+    ``pcpm_args`` = (spec, perm, valid, slot, u_src) as the dispatcher
+    appended them; returns (binned me, (spec, slot, u_src)) for the
+    kernel bodies."""
+    spec, perm, valid, slot, u_src = pcpm_args
+    return me[perm, :] & valid[:, None], (spec, slot, u_src)
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled(n_pad: int, m_pad: int, H: int, C: int, damping: float,
               tol: float, max_steps: int, tdt: str, warm: bool = False,
-              tile_budget: int | None = None):
+              tile_budget: int | None = None, pcpm=None):
     tdt = jnp.dtype(tdt)
 
     def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
             hop_of_col, T_col, w_col, *rest):
         me, mv = _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
                                hop_of_col, T_col, w_col)
+        pc = None
+        if pcpm is not None:
+            *rest, perm, valid, slot, u_src = rest
+            me, pc = _bin_masks(me, (pcpm, perm, valid, slot, u_src))
         # warm arg: previous chunk's full [C, n_pad] output; tail slice +
         # per-hop tile in-program (see _compiled_delta)
         W = C // H
         r0 = jnp.tile(rest[0][-W:], (H, 1)).T if warm else None
         return _pagerank_columns(me, mv, e_src, e_dst, n_pad,
                                  damping, tol, max_steps, r_init=r0,
-                                 tile_budget=tile_budget)
+                                 tile_budget=tile_budget, pcpm=pc)
 
-    return _ledger.instrument("hopbatch.pagerank_cols", jax.jit(run))
+    return _ledger.instrument("hopbatch.pagerank_cols", jax.jit(run),
+                              traffic=_traffic(m_pad, C, n_pad, pcpm))
 
 
 @functools.lru_cache(maxsize=64)
@@ -271,7 +320,7 @@ def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
                     U_e: int, U_v: int, tdt: str, warm: bool,
                     algo_args: tuple, weighted: bool = False,
                     U_w: int = 0, h0: bool = False,
-                    tile_budget: int | None = None):
+                    tile_budget: int | None = None, pcpm=None):
     """Delta-fed columnar kernels: masks rebuilt on device from base state
     + per-hop deltas (``_masks_from_deltas``), then the shared algorithm
     body. ``kind``: pagerank | cc | bfs (``weighted`` adds a per-pair
@@ -279,12 +328,24 @@ def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
     static parameter tuple. ``h0=True`` is the resident-base variant: the
     base inputs are the previous dispatch's advanced state, delta[0] is
     applied before hop 0. Every variant returns ``(result, steps,
-    advanced_base)`` so the caller can keep the fold state on device."""
+    advanced_base)`` so the caller can keep the fold state on device.
+
+    ``pcpm`` (a ``PartitionSpec``) is the destination-binned variant: the
+    PAIR-side base arrays arrive pre-binned from the host, the pair delta
+    positions are pre-remapped to binned slots, and ``e_src``/``e_dst``
+    are the layout's global ``b_src``/``b_dst`` — the mask rebuild is then
+    IDENTICAL code over the binned coordinate space, and the advanced
+    base stays binned across resident batches. Trailing args carry the
+    layout's (slot, u_src) bucket tables."""
     tdt_ = jnp.dtype(tdt)
 
     def run(e_src, e_dst, be_lat, be_alive, bv_lat, bv_alive,
             de_pos, de_lat, de_alive, dv_pos, dv_lat, dv_alive,
             T_col, w_col, *rest):
+        pc = None
+        if pcpm is not None:
+            *rest, slot, u_src = rest
+            pc = (pcpm, slot, u_src)
         me, mv, adv = _masks_from_deltas(
             tdt_, H, W, be_lat, be_alive, bv_lat, bv_alive,
             de_pos, de_lat, de_alive, dv_pos, dv_lat, dv_alive,
@@ -297,12 +358,12 @@ def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
             r0 = jnp.tile(rest[0][-W:], (H, 1)).T if warm else None
             out, steps = _pagerank_columns(
                 me, mv, e_src, e_dst, n_pad, damping, tol, max_steps,
-                r_init=r0, tile_budget=tile_budget)
+                r_init=r0, tile_budget=tile_budget, pcpm=pc)
             return out, steps, adv
         if kind == "cc":
             (max_steps,) = algo_args
             out, steps = _cc_columns(me, mv, e_src, e_dst, n_pad, max_steps,
-                                     tile_budget=tile_budget)
+                                     tile_budget=tile_budget, pcpm=pc)
             return out, steps, adv
         max_steps, directed = algo_args
         ew = 1.0
@@ -318,10 +379,11 @@ def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
             adv = adv + (cur_w,)
         out, steps = _bfs_columns(me, mv, e_src, e_dst, n_pad, max_steps,
                                   directed, rest[0], ew,  # rest[0]: seeds
-                                  tile_budget=tile_budget)
+                                  tile_budget=tile_budget, pcpm=pc)
         return out, steps, adv
 
-    return _ledger.instrument(f"hopbatch.delta.{kind}", jax.jit(run))
+    return _ledger.instrument(f"hopbatch.delta.{kind}", jax.jit(run),
+                              traffic=_traffic(m_pad, H * W, n_pad, pcpm))
 
 
 def _pad_hop_deltas(deltas, H: int, tdt):
@@ -344,7 +406,8 @@ def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
                       windows, *, algo_args: tuple, seed_mask=None,
                       e_src_dev=None, e_dst_dev=None, r_init=None,
                       weight_base=None, weight_deltas=None,
-                      h0_delta: bool = False, ship_counter=None):
+                      h0_delta: bool = False, ship_counter=None,
+                      layout=None):
     """Dispatch a delta-fed columnar kernel (``kind``: pagerank|cc|bfs)
     over ``_HopBatched._fold_deltas`` output; returns ``(result, steps,
     advanced_base)``. ``weight_base`` + ``weight_deltas`` ([(pos, val)]
@@ -352,7 +415,14 @@ def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
     device too. ``h0_delta=True`` means ``base`` (and ``weight_base``)
     are the previous dispatch's device-resident advanced state and
     delta[0] carries the inter-batch catch-up — the sweep then ships
-    O(Σ delta) bytes with no full-table upload at all."""
+    O(Σ delta) bytes with no full-table upload at all.
+
+    ``layout`` (``ops/partition.PartitionLayout``) routes the dispatch
+    through the destination-binned kernels: pair-side base state is
+    permuted into the binned layout HERE (one O(m) fancy-index, skipped
+    entirely on resident batches whose device base is already binned) and
+    pair delta positions are remapped O(Σ delta); the layout's spec rides
+    into the compiled-program cache key."""
     H, C, _, T_col, w_col = _column_layout(hop_times, windows)
     W = C // H
     be_lat, be_alive, bv_lat, bv_alive = base
@@ -369,10 +439,23 @@ def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
         for h, (p, v) in enumerate(weight_deltas):
             dw_pos[h, : len(p)] = p
             dw_val[h, : len(v)] = v
+    if layout is not None:
+        if not h0_delta:
+            # host engine-order base → binned (resident bases are the
+            # previous BINNED dispatch's advanced state, passed through)
+            be_lat, be_alive = layout.bin_base(be_lat, be_alive)
+            if weighted:
+                weight_base = layout.bin_values(weight_base)
+        de_pos = layout.remap_positions(de_pos)
+        if weighted:
+            dw_pos = layout.remap_positions(dw_pos)
+        b_src, b_dst, _valid, b_slot, b_usrc, _perm = layout.device_args()
+        e_src_dev, e_dst_dev = b_src, b_dst
     runner = _compiled_delta(kind, tables.n_pad, tables.m_pad, H, W,
                              U_e, U_v, np.dtype(tdt).name,
                              r_init is not None, tuple(algo_args),
-                             weighted, U_w, h0_delta, _tile_budget_bytes())
+                             weighted, U_w, h0_delta, _tile_budget_bytes(),
+                             None if layout is None else layout.spec)
     if ship_counter is not None:
         # FOLD-STATE host→device payload of THIS dispatch (padded shapes;
         # device-resident inputs — h0 base, cached tables — ship nothing).
@@ -394,13 +477,15 @@ def run_columns_delta(kind, tables, base, deltas_e, deltas_v, hop_times,
         extra.extend((weight_base, dw_pos, dw_val))
     if r_init is not None:
         extra.append(r_init)
+    if layout is not None:
+        extra.extend((b_slot, b_usrc))   # device-resident bucket tables
     # the whole dispatch payload ships through the pipelined engine: array
     # k+1 stages while k is on the wire, each slice retried on transport
     # errors (device-resident inputs pass through untouched)
     from ..utils.transfer import shared_engine
 
     with TRACER.span("hop.compute", kind=kind, hops=H, cols=H * W,
-                        resident_base=h0_delta):
+                        resident_base=h0_delta, pcpm=layout is not None):
         return runner(*shared_engine().put_many([
             e_src_dev if e_src_dev is not None else tables.e_src,
             e_dst_dev if e_dst_dev is not None else tables.e_dst,
@@ -444,12 +529,15 @@ def _edge_accumulate(seg, payload_of, combine, init, e_from, e_to, me, ew,
 
 
 def _cc_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
-                tile_budget: int | None = None):
+                tile_budget: int | None = None, pcpm=None):
     """Columnar min-label propagation — connected components for every
     (hop, window) column at once (semantics of
     ``algorithms/connected_components.py``: undirected min over both
     directions, labels are global padded indices). Shared by the
-    single-device kernel and the column-sharded mesh runner."""
+    single-device kernel and the column-sharded mesh runner. ``pcpm``
+    switches to the destination-binned operands (``_pagerank_columns``
+    docstring); min reductions are order-exact, so binned results stay
+    BITWISE equal to the unbinned route."""
     I32_MAX = jnp.iinfo(jnp.int32).max
     lab0 = jnp.where(mv, jnp.arange(n_pad, dtype=jnp.int32)[:, None],
                      I32_MAX)
@@ -460,14 +548,19 @@ def _cc_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
     def body(carry):
         step, lab, halted = carry
 
-        def pull(idx_from, idx_to, sorted_):
+        def pull(idx_from, idx_to, sorted_, pre=None):
+            pay = lambda ef, mk, _: jnp.where(mk, lab[ef, :], I32_MAX)
+            if pre is not None and tile is None and pre[0].preagg:
+                _, slot, u_src = pre
+                vals = lab[u_src, :]                  # bucket gather
+                pay = lambda ef, mk, _: jnp.where(mk, vals[slot, :],
+                                                  I32_MAX)
             return _edge_accumulate(
-                jax.ops.segment_min,
-                lambda ef, mk, _: jnp.where(mk, lab[ef, :], I32_MAX),
+                jax.ops.segment_min, pay,
                 jnp.minimum, max0, idx_from, idx_to, me, None,
                 n_pad, tile, sorted_)
 
-        agg = jnp.minimum(pull(e_src, e_dst, True),
+        agg = jnp.minimum(pull(e_src, e_dst, pcpm is None, pre=pcpm),
                           pull(e_dst, e_src, False))
         new = jnp.where(mv, jnp.minimum(lab, agg), I32_MAX)
         col_done = jnp.all(new == lab, axis=0)
@@ -488,25 +581,32 @@ def _cc_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
 
 @functools.lru_cache(maxsize=64)
 def _compiled_cc(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
-                 tdt: str, tile_budget: int | None = None):
+                 tdt: str, tile_budget: int | None = None, pcpm=None):
     tdt = jnp.dtype(tdt)
 
     def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
-            hop_of_col, T_col, w_col):
+            hop_of_col, T_col, w_col, *rest):
         me, mv = _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
                                hop_of_col, T_col, w_col)
+        pc = None
+        if pcpm is not None:
+            me, pc = _bin_masks(me, (pcpm,) + rest[-4:])
         return _cc_columns(me, mv, e_src, e_dst, n_pad, max_steps,
-                           tile_budget=tile_budget)
+                           tile_budget=tile_budget, pcpm=pc)
 
-    return _ledger.instrument("hopbatch.cc_cols", jax.jit(run))
+    return _ledger.instrument("hopbatch.cc_cols", jax.jit(run),
+                              traffic=_traffic(m_pad, C, n_pad, pcpm))
 
 
 def _bfs_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
                  directed: bool, seed_mask, ew,
-                 tile_budget: int | None = None):
+                 tile_budget: int | None = None, pcpm=None):
     """Columnar min-plus traversal (``algorithms/traversal.SSSP``
-    semantics); ``ew`` is 1.0 for hop counting or [m_pad, C] f32 weights.
-    Shared by the single-device kernel and the column-sharded runner."""
+    semantics); ``ew`` is 1.0 for hop counting or [m_pad, C] f32 weights
+    (BINNED when ``pcpm`` is set, like ``me``/``e_src``/``e_dst`` — see
+    ``_pagerank_columns``). Min-plus is order-exact, so binned results
+    stay bitwise equal. Shared by the single-device kernel and the
+    column-sharded runner."""
     INF = jnp.float32(jnp.inf)
     d0 = jnp.where(mv & seed_mask[:, None], 0.0, INF)
     tile = _edge_tile_for(e_src.shape[0], me.shape[1], tile_budget)
@@ -517,15 +617,20 @@ def _bfs_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
     def body(carry):
         step, dist, halted = carry
 
-        def pull(idx_from, idx_to, sorted_):
+        def pull(idx_from, idx_to, sorted_, pre=None):
+            pay = lambda ef, mk, ex: jnp.where(
+                mk, dist[ef, :] + (ew if ex is None else ex), INF)
+            if pre is not None and tile is None and pre[0].preagg:
+                _, slot, u_src = pre
+                vals = dist[u_src, :]                 # bucket gather
+                pay = lambda ef, mk, ex: jnp.where(
+                    mk, vals[slot, :] + (ew if ex is None else ex), INF)
             return _edge_accumulate(
-                jax.ops.segment_min,
-                lambda ef, mk, ex: jnp.where(
-                    mk, dist[ef, :] + (ew if ex is None else ex), INF),
+                jax.ops.segment_min, pay,
                 jnp.minimum, inf0, idx_from, idx_to, me, ew_arr,
                 n_pad, tile, sorted_)
 
-        agg = pull(e_src, e_dst, True)
+        agg = pull(e_src, e_dst, pcpm is None, pre=pcpm)
         if not directed:
             agg = jnp.minimum(agg, pull(e_dst, e_src, False))
         new = jnp.where(mv, jnp.minimum(dist, agg), INF)
@@ -548,7 +653,7 @@ def _bfs_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
 @functools.lru_cache(maxsize=64)
 def _compiled_bfs(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
                   directed: bool, tdt: str, weighted: bool = False,
-                  tile_budget: int | None = None):
+                  tile_budget: int | None = None, pcpm=None):
     tdt = jnp.dtype(tdt)
 
     def run(e_src, e_dst, e_lat, e_alive, v_lat, v_alive,
@@ -556,10 +661,17 @@ def _compiled_bfs(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
         me, mv = _column_masks(tdt, e_lat, e_alive, v_lat, v_alive,
                                hop_of_col, T_col, w_col)
         ew = rest[0][hop_of_col].T if weighted else 1.0   # [m_pad, C]
+        pc = None
+        if pcpm is not None:
+            me, pc = _bin_masks(me, (pcpm,) + rest[-4:])
+            if weighted:
+                ew = ew[rest[-4], :]   # weights follow the edge permutation
         return _bfs_columns(me, mv, e_src, e_dst, n_pad, max_steps,
-                            directed, seed_mask, ew, tile_budget=tile_budget)
+                            directed, seed_mask, ew,
+                            tile_budget=tile_budget, pcpm=pc)
 
-    return _ledger.instrument("hopbatch.bfs_cols", jax.jit(run))
+    return _ledger.instrument("hopbatch.bfs_cols", jax.jit(run),
+                              traffic=_traffic(m_pad, C, n_pad, pcpm))
 
 
 def _seed_mask(tables, seed_vids) -> np.ndarray:
@@ -575,10 +687,19 @@ def _seed_mask(tables, seed_vids) -> np.ndarray:
     return seed_mask
 
 
+def _layout_dispatch_args(layout):
+    """(e_src_dev, e_dst_dev, trailing pcpm args) for a host-column
+    dispatch through the binned kernels — the edge operands become the
+    layout's global ``b_src``/``b_dst`` and the kernels bin the fold-state
+    masks in-program via the appended (perm, valid, slot, u_src)."""
+    b_src, b_dst, valid, slot, u_src, perm = layout.device_args()
+    return b_src, b_dst, (perm, valid, slot, u_src)
+
+
 def run_bfs_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
                     windows, seed_vids, *, directed: bool = False,
                     max_steps: int = 100, e_src_dev=None, e_dst_dev=None,
-                    weight_cols=None):
+                    weight_cols=None, layout=None):
     """Columnar min-plus traversal over prebuilt fold columns;
     ``seed_vids`` are external vertex ids looked up in the global dense
     space (absent ids ignored). ``weight_cols`` ([H, m_pad] f32, missing
@@ -587,9 +708,13 @@ def run_bfs_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
     seed_mask = _seed_mask(tables, seed_vids)
     runner = _compiled_bfs(tables.n_pad, tables.m_pad, H, C, int(max_steps),
                            bool(directed), np.dtype(tables.tdtype).name,
-                           weight_cols is not None, _tile_budget_bytes())
+                           weight_cols is not None, _tile_budget_bytes(),
+                           None if layout is None else layout.spec)
     extra = (seed_mask,) if weight_cols is None \
         else (seed_mask, weight_cols)
+    if layout is not None:
+        e_src_dev, e_dst_dev, pc = _layout_dispatch_args(layout)
+        extra = extra + pc
     return _dispatch_columns(runner, tables,
                              (e_lat, e_alive, v_lat, v_alive),
                              hop_of_col, T_col, w_col, e_src_dev, e_dst_dev,
@@ -598,14 +723,19 @@ def run_bfs_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
 
 def run_cc_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
                    windows, *, max_steps: int = 100,
-                   e_src_dev=None, e_dst_dev=None):
+                   e_src_dev=None, e_dst_dev=None, layout=None):
     """Columnar connected components over prebuilt per-hop fold columns."""
     H, C, hop_of_col, T_col, w_col = _column_layout(hop_times, windows)
     runner = _compiled_cc(tables.n_pad, tables.m_pad, H, C, int(max_steps),
-                          np.dtype(tables.tdtype).name, _tile_budget_bytes())
+                          np.dtype(tables.tdtype).name, _tile_budget_bytes(),
+                          None if layout is None else layout.spec)
+    extra = ()
+    if layout is not None:
+        e_src_dev, e_dst_dev, extra = _layout_dispatch_args(layout)
     return _dispatch_columns(runner, tables,
                              (e_lat, e_alive, v_lat, v_alive),
-                             hop_of_col, T_col, w_col, e_src_dev, e_dst_dev)
+                             hop_of_col, T_col, w_col, e_src_dev, e_dst_dev,
+                             *extra)
 
 
 def _payload_nbytes(obj) -> int:
@@ -676,6 +806,13 @@ class _HopBatched:
         # so follow-on chunks/batches ship only deltas (the host↔device
         # link, not the fold, is the binding cost on a tunnelled device)
         self._dev_base = None
+        # the PCPM layout spec the resident base is expressed in (None =
+        # engine order): a knob flip between batches must drop residency,
+        # never scatter one layout's delta onto the other's state
+        self._dev_base_spec = None
+        # the run's resolved partition layout (ops/partition.py), fixed
+        # for the whole run at its start — None on the unbinned route
+        self._active_layout = None
 
     @property
     def _e_src(self):
@@ -711,7 +848,26 @@ class _HopBatched:
             self._dev_base = None
             raise
         self._dev_base = adv
+        self._dev_base_spec = (None if self._active_layout is None
+                               else self._active_layout.spec)
         return out, steps
+
+    def _sync_layout(self):
+        """Resolve the partition layout ONCE per run (``RTPU_PCPM`` /
+        ``RTPU_PARTITIONS`` are dispatch-time knobs), and drop the
+        device-resident advanced base when it is expressed in a different
+        edge layout than this run will dispatch in — a catch-up delta
+        remapped for one layout scattered onto the other's state would be
+        silently wrong, not slow."""
+        from ..ops import partition as _partition
+
+        lay = _partition.resolve(self._log, self.tables,
+                                 _tile_budget_bytes())
+        spec = None if lay is None else lay.spec
+        if self._dev_base is not None and self._dev_base_spec != spec:
+            self._dev_base = None
+        self._active_layout = lay
+        return lay
 
     #: set True by subclasses whose iteration is a contraction (safe to
     #: warm-start from the previous chunk's solution)
@@ -776,6 +932,7 @@ class _HopBatched:
         self.fold_mode_seconds = {}
         self.fold_stall_seconds = 0.0
         self.ship_bytes = 0
+        self._sync_layout()
         if warm_start and not self.supports_warm_start:
             raise ValueError(
                 f"{type(self).__name__} cannot warm-start: its superstep "
@@ -1051,6 +1208,7 @@ class _HopBatched:
         to what ``run(hop_times, ..., chunks=chunks)`` would dispatch."""
         hop_times = [int(x) for x in hop_times]
         chunks = max(1, min(int(chunks), len(hop_times)))
+        self._sync_layout()
         if chunks > 1 and len(hop_times) % chunks:
             chunks = 1
         per = len(hop_times) // chunks
@@ -1497,7 +1655,8 @@ class HopBatchedPageRank(_HopBatched):
         return run_columns(
             self.tables, *cols, hop_times, windows,
             damping=self.damping, tol=self.tol, max_steps=self.max_steps,
-            e_src_dev=self._e_src, e_dst_dev=self._e_dst, r_init=r_init)
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst, r_init=r_init,
+            layout=self._active_layout)
 
     def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
         base, deltas_e, deltas_v = payload
@@ -1508,7 +1667,8 @@ class HopBatchedPageRank(_HopBatched):
             algo_args=(float(self.damping), float(self.tol),
                        int(self.max_steps)),
             e_src_dev=self._e_src, e_dst_dev=self._e_dst, r_init=r_init,
-            h0_delta=h0, ship_counter=self._count_ship))
+            h0_delta=h0, ship_counter=self._count_ship,
+            layout=self._active_layout))
 
 
 class HopBatchedBFS(_HopBatched):
@@ -1545,7 +1705,8 @@ class HopBatchedBFS(_HopBatched):
         return run_bfs_columns(
             self.tables, *cols, hop_times, windows, self.seeds,
             directed=self.directed, max_steps=self.max_steps,
-            e_src_dev=self._e_src, e_dst_dev=self._e_dst)
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst,
+            layout=self._active_layout)
 
     def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
         assert r_init is None   # guarded by supports_warm_start
@@ -1556,7 +1717,8 @@ class HopBatchedBFS(_HopBatched):
             hop_times, windows,
             algo_args=(int(self.max_steps), bool(self.directed)),
             seed_mask=self._seed,
-            e_src_dev=self._e_src, e_dst_dev=self._e_dst, h0_delta=h0, ship_counter=self._count_ship))
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst, h0_delta=h0,
+            ship_counter=self._count_ship, layout=self._active_layout))
 
 
 class HopBatchedSSSP(HopBatchedBFS):
@@ -1704,7 +1866,7 @@ class HopBatchedSSSP(HopBatchedBFS):
             self.tables, *base, hop_times, windows, self.seeds,
             directed=self.directed, max_steps=self.max_steps,
             e_src_dev=self._e_src, e_dst_dev=self._e_dst,
-            weight_cols=wcols)
+            weight_cols=wcols, layout=self._active_layout)
 
     def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
         assert r_init is None   # guarded by supports_warm_start
@@ -1717,7 +1879,8 @@ class HopBatchedSSSP(HopBatchedBFS):
             windows, algo_args=(int(self.max_steps), bool(self.directed)),
             seed_mask=self._seed,
             e_src_dev=self._e_src, e_dst_dev=self._e_dst,
-            weight_base=w_base, weight_deltas=w_deltas, h0_delta=h0, ship_counter=self._count_ship))
+            weight_base=w_base, weight_deltas=w_deltas, h0_delta=h0,
+            ship_counter=self._count_ship, layout=self._active_layout))
 
 
 class HopBatchedCC(_HopBatched):
@@ -1737,14 +1900,16 @@ class HopBatchedCC(_HopBatched):
         return self._run_delta(lambda: run_columns_delta(
             "cc", self.tables, base, deltas_e, deltas_v,
             hop_times, windows, algo_args=(int(self.max_steps),),
-            e_src_dev=self._e_src, e_dst_dev=self._e_dst, h0_delta=h0, ship_counter=self._count_ship))
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst, h0_delta=h0,
+            ship_counter=self._count_ship, layout=self._active_layout))
 
     def _dispatch_cols(self, cols, hop_times, windows, r_init=None):
         assert r_init is None   # guarded by supports_warm_start
         return run_cc_columns(
             self.tables, *cols, hop_times, windows,
             max_steps=self.max_steps,
-            e_src_dev=self._e_src, e_dst_dev=self._e_dst)
+            e_src_dev=self._e_src, e_dst_dev=self._e_dst,
+            layout=self._active_layout)
 
 
 def _dispatch_columns(runner, tables, cols, hop_of_col, T_col,
@@ -1767,7 +1932,7 @@ def _dispatch_columns(runner, tables, cols, hop_of_col, T_col,
 def _compiled_scale(n_pad: int, m_pad: int, H: int, W: int, U_e: int,
                     U_v: int, damping: float, tol: float, max_steps: int,
                     scan_masks: bool = False,
-                    tile_budget: int | None = None):
+                    tile_budget: int | None = None, pcpm=None):
     """Scale variant of the columnar PageRank: per-hop fold state is
     REBUILT ON DEVICE from the base state plus per-hop update lists, so a
     sweep ships O(base + deltas) bytes instead of O(m_pad * H) — at
@@ -1784,32 +1949,48 @@ def _compiled_scale(n_pad: int, m_pad: int, H: int, W: int, U_e: int,
     the fallback shape for remote compilers that choke on the unrolled
     program (RTPU_SCALE_MASKS=scan); results are identical (tested)."""
 
-    def run(e_src, e_dst, base_e, base_v, de_pos, de_t, dv_pos, dv_t, thr):
+    def run(e_src, e_dst, base_e, base_v, de_pos, de_t, dv_pos, dv_t, thr,
+            *rest):
         thr_hw = thr.reshape(H, W)
+        # binned variant: hop state still advances in ENGINE order (the
+        # update lists target engine positions) — only the mask COLUMNS
+        # are emitted through the layout permutation, one cheap 1-D
+        # gather of the running scatter-max per hop
+        pc = perm = valid = None
+        if pcpm is not None:
+            perm, valid, slot, u_src = rest
+            pc = (pcpm, slot, u_src)
 
-        def hop_masks(base, d_pos, d_t):
+        def hop_masks(base, d_pos, d_t, bin_rows: bool):
+            def col_of(cur, th):
+                if bin_rows and perm is not None:
+                    return (cur[perm][:, None] >= th[None, :]) \
+                        & valid[:, None]
+                return cur[:, None] >= th[None, :]
+
             if scan_masks:
                 def step(cur, inp):
                     pos, tt, th = inp
                     cur = cur.at[pos].max(tt)
-                    return cur, cur[:, None] >= th[None, :]   # [len, W]
+                    return cur, col_of(cur, th)               # [len, W]
 
                 _, cols = jax.lax.scan(step, base, (d_pos, d_t, thr_hw))
                 # [H, len, W] -> [len, H*W] hop-major
                 return jnp.swapaxes(cols, 0, 1).reshape(
-                    base.shape[0], H * W)
+                    cols.shape[1], H * W)
             cur, cols = base, []
             for h in range(H):     # H static and small: unrolled
                 cur = cur.at[d_pos[h]].max(d_t[h])
-                cols.append(cur[:, None] >= thr[h * W:(h + 1) * W][None, :])
+                cols.append(col_of(cur, thr[h * W:(h + 1) * W]))
             return jnp.concatenate(cols, axis=1)   # [len, H*W] hop-major
-        me = hop_masks(base_e, de_pos, de_t)
-        mv = hop_masks(base_v, dv_pos, dv_t)
+        me = hop_masks(base_e, de_pos, de_t, True)
+        mv = hop_masks(base_v, dv_pos, dv_t, False)
         return _pagerank_columns(me, mv, e_src, e_dst, n_pad,
                                  damping, tol, max_steps,
-                                 tile_budget=tile_budget)
+                                 tile_budget=tile_budget, pcpm=pc)
 
-    return _ledger.instrument("hopbatch.pagerank_scale", jax.jit(run))
+    return _ledger.instrument("hopbatch.pagerank_scale", jax.jit(run),
+                              traffic=_traffic(m_pad, H * W, n_pad, pcpm))
 
 
 def _delta_fingerprint(deltas_e, deltas_v) -> tuple:
@@ -1915,15 +2096,27 @@ def run_scale_columns(bulk, base_e, base_v, deltas_e, deltas_v, hop_times,
                 "mislabelled; re-run prepare_scale_payload on these deltas")
     import os
 
+    from ..ops import partition as _partition
+
     scan_masks = os.environ.get("RTPU_SCALE_MASKS", "unroll") == "scan"
+    budget = _tile_budget_bytes()
+    # RTPU_PCPM / RTPU_PARTITIONS resolved here, at dispatch — the spec
+    # carries both knobs into the compiled-program cache key
+    layout = _partition.resolve(bulk, bulk, budget)
+    extra = ()
+    if layout is not None:
+        b_src, b_dst, valid, slot, u_src, perm = layout.device_args()
+        e_src_dev, e_dst_dev = b_src, b_dst
+        extra = (perm, valid, slot, u_src)
     runner = _compiled_scale(bulk.n_pad, bulk.m_pad, H, W, U_e, U_v,
                              float(damping), float(tol), int(max_steps),
-                             scan_masks, _tile_budget_bytes())
+                             scan_masks, budget,
+                             None if layout is None else layout.spec)
     return runner(
         e_src_dev if e_src_dev is not None else jnp.asarray(bulk.e_src),
         e_dst_dev if e_dst_dev is not None else jnp.asarray(bulk.e_dst),
         jnp.asarray(base_e), jnp.asarray(base_v),
-        de_pos, de_t, dv_pos, dv_t, thr)
+        de_pos, de_t, dv_pos, dv_t, thr, *extra)
 
 
 def _column_layout(hop_times, windows):
@@ -1940,7 +2133,7 @@ def _column_layout(hop_times, windows):
 def run_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times, windows,
                 *, damping: float = 0.85, tol: float = 1e-7,
                 max_steps: int = 20, e_src_dev=None, e_dst_dev=None,
-                r_init=None):
+                r_init=None, layout=None):
     """Dispatch the columnar PageRank over prebuilt per-hop fold columns —
     shared by the incremental-fold class above and the add-only bulk loader
     (``core/bulk.bulk_hop_columns``). `tables` needs the GlobalTables /
@@ -1952,8 +2145,12 @@ def run_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times, windows,
     runner = _compiled(tables.n_pad, tables.m_pad, H, C, float(damping),
                        float(tol), int(max_steps),
                        np.dtype(tables.tdtype).name, r_init is not None,
-                       _tile_budget_bytes())
+                       _tile_budget_bytes(),
+                       None if layout is None else layout.spec)
     extra = () if r_init is None else (r_init,)
+    if layout is not None:
+        e_src_dev, e_dst_dev, pc = _layout_dispatch_args(layout)
+        extra = extra + pc
     return _dispatch_columns(runner, tables,
                              (e_lat, e_alive, v_lat, v_alive),
                              hop_of_col, T_col, w_col, e_src_dev, e_dst_dev,

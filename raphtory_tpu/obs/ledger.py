@@ -219,16 +219,33 @@ class KernelRegistry:
                     "flops": None, "bytes_accessed": None,
                     "temp_bytes": None, "argument_bytes": None,
                     "output_bytes": None, "intensity": None,
+                    "est_hbm_bytes": None, "bound_refined": None,
                 }
                 self._kernels[key] = rec
             return rec
 
-    def harvest(self, name: str, sig: tuple, fn, args) -> dict:
+    def harvest(self, name: str, sig: tuple, fn, args,
+                traffic: dict | None = None) -> dict:
         """Harvest ``cost_analysis``/``memory_analysis`` for one compiled
         (kernel, shapes) through the AOT path — BEFORE the dispatch call,
         so donated buffers are still alive for tracing. Never raises:
-        any failure leaves the record in host-side mode."""
+        any failure leaves the record in host-side mode.
+
+        ``traffic`` is an optional ENGINE-SIDE DRAM traffic model
+        (``ops/partition.edge_traffic_model``): XLA's ``bytes_accessed``
+        sums logical operand bytes and is blind to access LOCALITY, so a
+        partition-binned kernel that turns random cacheline traffic into
+        cache-resident streams harvests the same (or higher) logical
+        bytes. The model supplies ``est_hbm_bytes`` — what the kernel is
+        expected to move through DRAM — and the record carries BOTH, plus
+        a ``bound_refined`` classification over the modelled bytes
+        (docs/OBSERVABILITY.md "Cost ledger")."""
         rec = self._ensure(name, sig)
+        if traffic:
+            with self._lock:
+                rec["traffic_model"] = dict(traffic)
+                rec["est_hbm_bytes"] = int(
+                    traffic.get("est_hbm_bytes") or 0) or None
         caps = xla_analysis_caps()
         if not (caps["cost"] or caps["memory"]):
             return rec
@@ -257,6 +274,14 @@ class KernelRegistry:
                 updates["intensity"] = round(flops / nbytes, 4)
             updates["bound"] = classify_roofline(flops, nbytes,
                                                  caps.get("platform"))
+            hbm = (rec.get("est_hbm_bytes") if traffic
+                   else (int(nbytes) if nbytes else None))
+            if not traffic:
+                updates["est_hbm_bytes"] = hbm
+            updates["bound_refined"] = classify_roofline(
+                flops, hbm, caps.get("platform"))
+            if flops and hbm:
+                updates["intensity_refined"] = round(flops / hbm, 4)
             with self._lock:
                 rec.update(updates)
             TRACER.instant("ledger.kernel", kernel=name,
@@ -303,11 +328,12 @@ class InstrumentedKernel:
     analysis once per argument-shape signature. With ``RTPU_LEDGER=0``
     the wrapper is a single env-read passthrough."""
 
-    __slots__ = ("name", "fn", "_seen", "_lock")
+    __slots__ = ("name", "fn", "traffic", "_seen", "_lock")
 
-    def __init__(self, name: str, fn):
+    def __init__(self, name: str, fn, traffic: dict | None = None):
         self.name = name
         self.fn = fn
+        self.traffic = traffic
         self._seen: set = set()
         self._lock = threading.Lock()
 
@@ -323,7 +349,8 @@ class InstrumentedKernel:
             # BEFORE the dispatch: donated buffers must still be alive
             # when lower() traces; the AOT compile lands in (or seeds)
             # the same in-memory XLA cache the call below hits
-            REGISTRY.harvest(self.name, sig, self.fn, args)
+            REGISTRY.harvest(self.name, sig, self.fn, args,
+                             traffic=self.traffic)
         out = self.fn(*args)
         rec = REGISTRY.note_dispatch(self.name, sig)
         led = current()
@@ -337,10 +364,13 @@ class InstrumentedKernel:
         return f"InstrumentedKernel({self.name!r})"
 
 
-def instrument(name: str, fn) -> InstrumentedKernel:
+def instrument(name: str, fn,
+               traffic: dict | None = None) -> InstrumentedKernel:
     """Wrap a jitted callable for the kernel registry — what every
-    compiled-program cache in ``engine/`` returns."""
-    return InstrumentedKernel(name, fn)
+    compiled-program cache in ``engine/`` returns. ``traffic`` is an
+    optional engine-side DRAM traffic model recorded next to the XLA
+    harvest (see :meth:`KernelRegistry.harvest`)."""
+    return InstrumentedKernel(name, fn, traffic)
 
 
 # ---------------------------------------------------------------- ledger
@@ -418,12 +448,20 @@ class Ledger:
             if k is None:
                 k = self.kernels[name] = {
                     "dispatches": 0, "est_flops": 0.0,
-                    "est_bytes_accessed": 0.0, "bound": "unknown"}
+                    "est_bytes_accessed": 0.0, "est_hbm_bytes": 0.0,
+                    "bound": "unknown"}
             k["dispatches"] += 1
             k["est_flops"] += float(rec.get("flops") or 0.0)
             k["est_bytes_accessed"] += float(
                 rec.get("bytes_accessed") or 0.0)
+            # the locality-aware per-dispatch traffic estimate (falls
+            # back to the logical XLA bytes when no model is attached)
+            k["est_hbm_bytes"] += float(
+                rec.get("est_hbm_bytes")
+                or rec.get("bytes_accessed") or 0.0)
             k["bound"] = rec.get("bound", "unknown")
+            if rec.get("bound_refined"):
+                k["bound_refined"] = rec["bound_refined"]
 
     def count_views(self, n: int = 1) -> None:
         with self._lock:
@@ -460,6 +498,9 @@ class Ledger:
                     mine["dispatches"] += k["dispatches"]
                     mine["est_flops"] += k["est_flops"]
                     mine["est_bytes_accessed"] += k["est_bytes_accessed"]
+                    mine["est_hbm_bytes"] = (
+                        mine.get("est_hbm_bytes", 0.0)
+                        + k.get("est_hbm_bytes", 0.0))
             self.sweeps += snap["sweeps"]
             self.views += snap["views"]
             self.supersteps += snap["supersteps"]
@@ -644,8 +685,15 @@ def costz() -> dict:
         "classification_rule": (
             "intensity = flops / bytes_accessed; hbm_bound if intensity "
             "< ridge else compute_bound; unknown without harvested "
-            "analysis"),
+            "analysis. bound_refined repeats the rule over est_hbm_bytes "
+            "— the engine-side partition-aware DRAM traffic model "
+            "(ops/partition.edge_traffic_model) where one is attached, "
+            "since XLA's bytes_accessed is blind to access locality"),
         "kernels": kernels,
         "kernels_by_bound": KernelRegistry.bound_counts(kernels),
+        "kernels_by_bound_refined": {
+            b: n for b, n in KernelRegistry.bound_counts(
+                [{"bound": r.get("bound_refined") or "unknown"}
+                 for r in kernels]).items()},
         "recent_queries": recent_queries(),
     }

@@ -202,8 +202,15 @@ def test_prewarm_pins_resident_sweep():
     # the background pin lands shortly after ingest
     import time as _t
 
+    # poll the ADVANCED state, not just the pin: resident_acquire
+    # publishes the sweep (under its lock) before the prewarm thread's
+    # advance() completes, so _resident turns non-None a few dozen ms
+    # ahead of t_now — reading t_now immediately is a race
     deadline = _t.monotonic() + 30
-    while node.graph._resident is None and _t.monotonic() < deadline:
+    while _t.monotonic() < deadline:
+        sweep = node.graph._resident
+        if sweep is not None and sweep.t_now == 399:
+            break
         _t.sleep(0.05)
     assert node.graph._resident is not None
     assert node.graph._resident.t_now == 399

@@ -76,6 +76,46 @@ def test_env_read_suppressed():
     assert fs == []
 
 
+def test_env_partition_count_in_cached_factory_flagged():
+    """The PR 7 bug class RT001 exists for: an env-derived PARTITION
+    COUNT resolved inside an lru_cached kernel factory (directly or
+    through the module-helper idiom) — flipping RTPU_PARTITIONS
+    mid-process would silently reuse programs binned for the old layout,
+    exactly the RTPU_TILE_BUDGET_MB failure of PR 2."""
+    fs = lint("""
+        import functools
+        import os
+
+        def _partition_count(n_pad):
+            ov = os.environ.get("RTPU_PARTITIONS")
+            return int(ov) if ov else max(1, n_pad // 2048)
+
+        @functools.lru_cache(maxsize=16)
+        def compiled_binned(n_pad, m_pad):
+            parts = _partition_count(n_pad)
+            return (n_pad, m_pad, parts)
+    """)
+    assert "env-not-in-cache-key" in rules_of(fs)
+    assert any("RTPU_PARTITIONS" in f.message for f in fs)
+
+    # the shipped idiom: the DISPATCH site resolves the knobs and the
+    # factory receives the layout's static spec as a cache-key argument
+    fs = lint("""
+        import functools
+        import os
+
+        @functools.lru_cache(maxsize=16)
+        def compiled_binned(n_pad, m_pad, pcpm_spec):
+            return (n_pad, m_pad, pcpm_spec)
+
+        def dispatch(n_pad, m_pad, layout):
+            enabled = os.environ.get("RTPU_PCPM", "auto") != "0"
+            spec = layout.spec if enabled else None
+            return compiled_binned(n_pad, m_pad, spec)
+    """)
+    assert fs == []
+
+
 def test_env_threaded_as_cache_key_clean():
     fs = lint("""
         import functools
