@@ -1434,6 +1434,157 @@ def bench_ledger_overhead():
     }
 
 
+def bench_sanitize_probe():
+    """ONE arm of the sanitize_overhead A/B, meant to run in a SUBPROCESS
+    with RTPU_SANITIZE pinned in the environment: the sanitizer installs
+    (or not) at package import, before any package lock or shared
+    structure exists — toggling it in-process would leave module-level
+    locks untracked and understate the on-arm. The probe times the
+    headline sweep shape (GC-quiesced best-of-2, warm fold cache) and
+    reports the sanitizer's finding counts so the parent can assert the
+    lockset race detector ran CLEAN."""
+    from raphtory_tpu.analysis import sanitizer as san_mod
+    from raphtory_tpu.engine.hopbatch import HopBatchedPageRank
+    from raphtory_tpu.utils.synth import gab_like_log
+
+    cheap = os.environ.get("RTPU_BENCH_CHEAP", "0") not in ("", "0")
+    if cheap:
+        # 12 hops (not the ledger config's 8): the sanitizer's per-lock-op
+        # cost is small, so the timed region must be long enough that
+        # this box's ±10% quiet-moment jitter doesn't swamp the signal
+        log = gab_like_log(n_vertices=8_000, n_edges=80_000,
+                           t_span=_GAB_SPAN)
+        n_hops = 12
+    else:
+        log = _gab_log()
+        n_hops = 12
+    view_times = np.linspace(0.45 * _GAB_SPAN, _GAB_SPAN,
+                             n_hops).astype(np.int64)
+    windows = [2_600_000, 604_800, 86_400]
+    hops = [int(T) for T in view_times]
+    n_chunks = _chunks(2 if cheap else 3, "PR")
+
+    warm = HopBatchedPageRank(log, tol=1e-7, max_steps=20)
+    _sync(warm.run(hops, windows, chunks=n_chunks, warm_start=True)[0])
+    del warm
+
+    def once():
+        hb = HopBatchedPageRank(log, tol=1e-7, max_steps=20)
+        ranks, steps = hb.run(hops, windows, chunks=n_chunks,
+                              warm_start=True)
+        return ranks, {"steps": int(steps)}
+
+    # best-of-3/4: single repeats on this shared box swing ±30% (a lock
+    # count shows ~286 tracked acquires ≈ 1 ms of real sanitizer work
+    # per full sweep — the arm floors differ by drift, not cost), so
+    # each probe reports its quietest repeat
+    elapsed, repeats, _aux, _ = _best_of(once, n=3 if cheap else 4)
+    san = san_mod.active()
+    counts: dict = {"installed": san is not None}
+    if san is not None:
+        for f in san.findings():
+            counts[f["kind"]] = counts.get(f["kind"], 0) + 1
+        counts["tracked_shared"] = len(san.shared_trackers())
+    return {
+        "config": "_sanitize_probe",
+        "metric": "one sanitize_overhead arm (internal probe)",
+        "value": round(elapsed, 4),
+        "unit": "sweep_seconds",
+        "detail": {
+            "sanitize": os.environ.get("RTPU_SANITIZE", "0"),
+            "cheap_mode": cheap,
+            "repeats": repeats,
+            "sanitizer": counts,
+        },
+    }
+
+
+def bench_sanitize_overhead():
+    """Runtime lock-sanitizer overhead on the headline sweep shape — the
+    concurrency gate's proof row (acceptance: < 5% on-vs-off, lockset
+    race detection INCLUDED on the on-arm).
+
+    Protocol: interleaved RTPU_SANITIZE=0/1 SUBPROCESS pairs (the
+    sanitizer must install before package import — see the probe's
+    docstring), per-pair ratios, MEDIAN reported (drift on the shared box
+    cancels within a pair). Probes share one persistent XLA compile
+    cache so each subprocess pays the compile once, not per arm. The
+    on-arm's sanitizer finding counts ride in the row, and zero
+    shared-state-race findings is part of the acceptance — the bench is
+    also the lockset detector's clean-baseline proof under a real sweep
+    load. RTPU_BENCH_CHEAP=1 shrinks the shape for CI (own *_cheap
+    perfwatch series; the value is a machine-portable percent)."""
+    import statistics
+    import tempfile
+
+    cheap = os.environ.get("RTPU_BENCH_CHEAP", "0") not in ("", "0")
+    pairs = 4
+    cache_dir = tempfile.mkdtemp(prefix="rtpu_sanbench_cache_")
+    base_env = {"RTPU_COMPILE_CACHE_DIR": cache_dir}
+
+    def probe(sanitize: str) -> dict:
+        row = _run_config_subproc(
+            "_sanitize_probe", timeout=600.0,
+            env={**base_env, "RTPU_SANITIZE": sanitize})
+        if row.get("unit") == "error":
+            raise RuntimeError(
+                f"sanitize probe (RTPU_SANITIZE={sanitize}) failed: "
+                f"{row.get('error')}")
+        return row
+
+    pair_seconds, on_counts = [], {}
+    for i in range(pairs):
+        # ABBA: alternate which arm runs first — a fixed order turns any
+        # monotone drift in box load into a systematic arm bias (observed
+        # ±17% both directions with off-always-first)
+        order = ("0", "1") if i % 2 == 0 else ("1", "0")
+        got = {s: probe(s) for s in order}
+        pair_seconds.append((got["0"]["value"], got["1"]["value"]))
+        on_counts = got["1"]["detail"]["sanitizer"]
+
+    ratios = [on_s / off_s for off_s, on_s in pair_seconds]
+    # primary estimator: min over ALL probes per arm (each probe is
+    # already a best-of-3). Per-pair ratios of sub-second subprocess
+    # runs on this shared box swing ±20% (observed both directions);
+    # the min-vs-min compares each arm's quietest moment, and ABBA
+    # ordering gives both arms equal access to quiet moments. The pair
+    # data rides in the row so the spread stays visible.
+    min_off = min(a for a, _ in pair_seconds)
+    min_on = min(b for _, b in pair_seconds)
+    overhead = min_on / min_off - 1.0
+    races = int(on_counts.get("shared-state-race", 0))
+    cycles = int(on_counts.get("lock-order-cycle", 0))
+    return {
+        "config": "sanitize_overhead_cheap" if cheap
+        else "sanitize_overhead",
+        "metric": ("runtime lock-sanitizer overhead on the headline "
+                   "sweep (RTPU_SANITIZE on vs off, lockset race "
+                   "detection on, "
+                   + ("CI cheap shape)" if cheap else "GAB-scale)")),
+        "value": round(overhead * 100.0, 2),
+        "unit": "percent_slower_with_sanitizer",
+        "detail": {
+            "cheap_mode": cheap,
+            "timing": ("abba_subprocess_pairs_min_vs_min — the sanitizer "
+                       "installs at package import, so each arm is its "
+                       "own process (best-of-3 inside); ABBA ordering + "
+                       "min-vs-min compares steady states instead of "
+                       "reading shared-box drift as overhead"),
+            "pair_seconds": [[round(a, 4), round(b, 4)]
+                             for a, b in pair_seconds],
+            "pair_ratios": [round(r, 4) for r in ratios],
+            "median_pair_overhead_percent": round(
+                (statistics.median(ratios) - 1.0) * 100.0, 2),
+            "acceptance": "min-vs-min on/off regression must stay < 5%; "
+                          "shared-state-race findings must be 0",
+            "on_arm_sanitizer": on_counts,
+            "lockset_race_findings": races,
+            "lock_order_cycles": cycles,
+            "baseline": "the sanitize-off column of this same row",
+        },
+    }
+
+
 def bench_pcpm_ab():
     """Partition-centric (PCPM) kernels vs the unbinned route — the
     destination-binned layout's proof row (docs/KERNELS.md).
@@ -1631,6 +1782,10 @@ CONFIGS = {
     "pcpm_ab": bench_pcpm_ab,
     "fold_parallel": bench_fold_parallel,
     "ledger_overhead": bench_ledger_overhead,
+    "sanitize_overhead": bench_sanitize_overhead,
+    # internal: one arm of sanitize_overhead, run in a subprocess with
+    # RTPU_SANITIZE pinned (underscore prefix = excluded from --suite)
+    "_sanitize_probe": bench_sanitize_probe,
     "transfer_pipeline": bench_transfer_pipeline,
     "trace_overhead": bench_trace_overhead,
     "gab_cc_range": bench_gab_cc_range,
@@ -1738,7 +1893,8 @@ def main():
     if args.config and not args.suite:
         names = [args.config]
     else:
-        names = [n for n in CONFIGS if n != "headline"] + ["headline"]
+        names = [n for n in CONFIGS
+                 if n != "headline" and not n.startswith("_")] + ["headline"]
 
     device = "uninitialised"
     probe: dict = {}

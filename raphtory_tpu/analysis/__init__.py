@@ -11,22 +11,29 @@ into CI gates).
 
 Two halves:
 
-* **Static rules** (``rules.py``) — AST passes over the package, run by
+* **Static rules** (``rules.py`` per-module, ``interproc.py`` +
+  ``concurrency.py`` project-wide) — AST passes run by
   ``tools/rtpulint`` (or ``python -m raphtory_tpu.analysis``) against a
-  checked-in baseline so CI fails only on NEW violations. Rule catalogue
-  and suppression syntax: ``docs/STATIC_ANALYSIS.md``.
+  checked-in baseline so CI fails only on NEW violations. v2 is
+  interprocedural: a module-resolving call graph, inferred thread
+  roots, and reaching locksets power RT009–RT011 and the cross-module
+  halves of RT001/RT003/RT004; ``fixes.py`` adds the RT008 ``--fix``
+  autofix. Rule catalogue and suppression syntax:
+  ``docs/STATIC_ANALYSIS.md``.
 * **Lock sanitizer** (``sanitizer.py``) — ``RTPU_SANITIZE=1`` wraps
   ``threading.Lock``/``RLock`` to build a lock-ordering graph, reports
-  cycles (potential deadlocks) and locks held across ``device_put`` /
-  compile boundaries, and mirrors findings into the ``obs.trace`` flight
-  recorder. Zero overhead when the env var is unset: nothing is patched.
+  cycles (potential deadlocks), locks held across ``device_put`` /
+  ``device_get`` / ``block_until_ready`` boundaries, and Eraser-style
+  lockset races over registered shared structures (``track_shared``),
+  mirroring findings into the ``obs.trace`` flight recorder. Zero
+  overhead when the env var is unset: nothing is patched.
 """
 
 from __future__ import annotations
 
 from .findings import Baseline, Finding
 from .rules import RULES, analyze_module, analyze_project
-from .sanitizer import LockSanitizer, install, uninstall
+from .sanitizer import LockSanitizer, install, track_shared, uninstall
 
 __all__ = [
     "Baseline",
@@ -36,5 +43,6 @@ __all__ = [
     "analyze_project",
     "LockSanitizer",
     "install",
+    "track_shared",
     "uninstall",
 ]

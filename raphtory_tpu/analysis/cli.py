@@ -21,6 +21,18 @@ DEFAULT_BASELINE = os.path.join("tools", "rtpulint_baseline.json")
 DEFAULT_DOCS = os.path.join("docs", "OPERATIONS.md")
 
 
+def _is_python_script(path: str) -> bool:
+    """Extensionless executables with a python shebang (tools/rtpulint,
+    tools/perfwatch) are source too — the tools/ scan must not skip the
+    linter's own drivers."""
+    try:
+        with open(path, "rb") as fh:
+            first = fh.readline(120)
+        return first.startswith(b"#!") and b"python" in first
+    except OSError:
+        return False
+
+
 def _iter_py_files(paths: list[str]) -> list[str]:
     out = []
     for p in paths:
@@ -30,8 +42,11 @@ def _iter_py_files(paths: list[str]) -> list[str]:
         for dirpath, dirnames, filenames in os.walk(p):
             dirnames[:] = sorted(d for d in dirnames
                                  if d not in ("__pycache__", ".git"))
-            out.extend(os.path.join(dirpath, f) for f in sorted(filenames)
-                       if f.endswith(".py"))
+            for f in sorted(filenames):
+                full = os.path.join(dirpath, f)
+                if f.endswith(".py") or \
+                        ("." not in f and _is_python_script(full)):
+                    out.append(full)
     return out
 
 
@@ -66,6 +81,21 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--output", default=None,
                     help="also write the json report here (any --format)")
+    ap.add_argument("--fix", action="store_true",
+                    help="apply mechanical autofixes in place (RT008 "
+                         "unused-import; idempotent, pragma-respecting) "
+                         "before reporting")
+    ap.add_argument("--fix-diff", default=None, metavar="PATH",
+                    help="write the unified diff --fix WOULD apply to "
+                         "PATH without modifying any file (the CI "
+                         "suggestion artifact)")
+    ap.add_argument("--timings", action="store_true",
+                    help="report per-rule wall seconds (text: stderr "
+                         "table; always included in the json report)")
+    ap.add_argument("--budget-seconds", type=float, default=None,
+                    metavar="S", help="fail (exit 1) when the analysis "
+                    "itself takes longer than S seconds — the CI proof "
+                    "that the interprocedural pass stays fast")
     args = ap.parse_args(argv)
 
     root = os.path.abspath(args.root)
@@ -95,9 +125,37 @@ def main(argv: list[str] | None = None) -> int:
             rules.add(RULES.get(r, r))
             rules.add(slugs.get(r, r))
 
-    findings = analyze_project([_load(f, root) for f in files],
+    sources = [_load(f, root) for f in files]
+
+    fixed_names = 0
+    if args.fix or args.fix_diff:
+        from .fixes import fix_files, unified_diff
+
+        fixed, fixed_names = fix_files(sources)
+        if args.fix_diff:
+            with open(args.fix_diff, "w", encoding="utf-8") as fh:
+                for rel in sorted(fixed):
+                    old = next(s for r, s in sources if r == rel)
+                    fh.write(unified_diff(rel, old, fixed[rel]))
+            print(f"rtpulint: wrote fix suggestions for {len(fixed)} "
+                  f"file(s) ({fixed_names} import(s)) to {args.fix_diff}",
+                  file=sys.stderr)
+        if args.fix:
+            by_rel = dict(zip([r for r, _ in sources], files))
+            for rel, new_src in sorted(fixed.items()):
+                with open(by_rel[rel], "w", encoding="utf-8") as fh:
+                    fh.write(new_src)
+            if fixed:
+                print(f"rtpulint: fixed {fixed_names} unused import(s) "
+                      f"in {len(fixed)} file(s)", file=sys.stderr)
+            # report on the FIXED sources — --fix then exits by what's left
+            sources = [(r, fixed.get(r, s)) for r, s in sources]
+
+    timings: dict = {}
+    findings = analyze_project(sources,
                                docs_text=docs_text, docs_name=docs_name,
-                               rules=rules)
+                               rules=rules, timings=timings)
+    analysis_seconds = sum(timings.values())
 
     baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
     if args.write_baseline:
@@ -129,6 +187,10 @@ def main(argv: list[str] | None = None) -> int:
         "new": [f.as_dict() for f in new],
         "accepted": [f.as_dict() for f in accepted],
         "stale_baseline_entries": stale,
+        "timings_seconds": {k: round(v, 3)
+                            for k, v in sorted(timings.items())},
+        "analysis_seconds": round(analysis_seconds, 3),
+        "autofixed_imports": fixed_names if args.fix else 0,
     }
     if args.output:
         with open(args.output, "w") as fh:
@@ -148,6 +210,16 @@ def main(argv: list[str] | None = None) -> int:
                      f"{'y' if stale == 1 else 'ies'} (consider "
                      f"--write-baseline)")
         print(tail)
+    if args.timings:
+        for rule_id, sec in sorted(timings.items()):
+            print(f"rtpulint:   {rule_id:<8} {sec:7.3f}s", file=sys.stderr)
+        print(f"rtpulint:   total    {analysis_seconds:7.3f}s",
+              file=sys.stderr)
+    if args.budget_seconds is not None and \
+            analysis_seconds > args.budget_seconds:
+        print(f"rtpulint: analysis took {analysis_seconds:.1f}s — over "
+              f"the {args.budget_seconds:.0f}s budget", file=sys.stderr)
+        return 1
     return 1 if new else 0
 
 

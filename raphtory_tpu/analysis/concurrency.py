@@ -1,0 +1,564 @@
+"""Interprocedural concurrency + taint rules (RT009–RT011, and the
+cross-module halves of RT001/RT003/RT004).
+
+These rules run over the :class:`~.interproc.Project` model: a resolved
+call graph, inferred thread roots, and reaching locksets. Each encodes a
+hazard the serving push (ROADMAP items 1/3/4) will otherwise mass-produce:
+REST handler threads, job threads, fold workers, and the scrape thread all
+share engine state that Akka actors isolated for free in the reference.
+
+Precision-first like the per-module rules: anything the resolver is not
+confident about is skipped, because the baseline is kept empty and every
+finding costs a source fix or a reviewed pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding
+from .interproc import (FuncInfo, Project, _enclosing_class,
+                        module_name_of)
+from .rules import (Module, RULES, _dotted, _donated_positions,
+                    _enclosing_def, _env_read_var, _is_cached_def,
+                    _is_jit_call, _module_mutables, _parent, _traced_defs)
+
+#: grow / shrink vocabulary for RT011
+_GROW_METHODS = {"append", "add", "appendleft", "extend", "insert", "put",
+                 "put_nowait", "setdefault", "update"}
+_SHRINK_METHODS = {"pop", "popitem", "popleft", "clear", "remove", "discard",
+                   "get_nowait", "task_done", "evict", "trim", "prune"}
+_BOUND_KWARGS = {"maxlen", "maxsize"}
+
+#: blocking boundaries for RT009 — the set the ISSUE names: device
+#: transfers, compiles, sleeps, socket I/O. ``.wait``/``.result`` are
+#: deliberately absent (condition waits RELEASE the lock; future results
+#: are how the fold pipeline is built).
+_BLOCKING_ATTRS = {"device_put", "device_get", "block_until_ready",
+                   "accept", "create_connection", "getaddrinfo", "urlopen",
+                   "recv", "recv_into", "sendall"}
+
+
+def _chain_str(chain) -> str:
+    return " -> ".join(f.label for f in chain)
+
+
+def _finding(mod: Module, rule: str, node: ast.AST, message: str,
+             symbol: str = "") -> Finding:
+    line = getattr(node, "lineno", 1)
+    return Finding(rule=rule, name=RULES[rule], path=mod.relpath, line=line,
+                   col=getattr(node, "col_offset", 0) + 1, message=message,
+                   symbol=symbol, line_text=mod.line_text(line))
+
+
+def _qualname_of(mod: Module, node: ast.AST) -> str:
+    names = []
+    cur = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            names.append(cur.name)
+        cur = _parent(cur)
+    return ".".join(reversed(names))
+
+
+def _is_blocking_call(node: ast.Call) -> str | None:
+    """A short label when ``node`` is a blocking boundary, else None."""
+    func = node.func
+    dotted = _dotted(func)
+    tail = dotted.split(".")[-1] if dotted else ""
+    if tail == "sleep":
+        base = dotted.rsplit(".", 1)[0] if "." in dotted else ""
+        if base in ("time", ""):
+            return "time.sleep"
+    if tail in _BLOCKING_ATTRS:
+        return tail
+    # fn.lower(*args).compile() — the AOT compile boundary
+    if isinstance(func, ast.Attribute) and func.attr == "compile" and \
+            isinstance(func.value, ast.Call) and \
+            isinstance(func.value.func, ast.Attribute) and \
+            func.value.func.attr == "lower":
+        return "lower().compile"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# RT009 blocking-call-under-lock
+
+
+def check_blocking_under_lock(project: Project) -> list[Finding]:
+    """A blocking boundary (``device_put``/``device_get``/
+    ``block_until_ready``/AOT compile/``time.sleep``/socket I/O) reachable
+    while a lock is held: every thread queued on that lock inherits the
+    stall — multi-second on a flapping interconnect (the runtime
+    sanitizer's lock-across-device-boundary finding, caught at lint time
+    and through call chains)."""
+    out: list[Finding] = []
+    reported: set = set()
+
+    def visit(fn: FuncInfo, node, locks, chain):
+        if not locks or not isinstance(node, ast.Call):
+            return
+        label = _is_blocking_call(node)
+        if label is None:
+            return
+        key = (id(node), frozenset(locks))
+        if key in reported:
+            return
+        reported.add(key)
+        sites = ", ".join(sorted(locks))
+        path = _chain_str(chain)
+        out.append(_finding(
+            fn.mod, "RT009", node,
+            f"blocking call {label}() reachable while lock(s) [{sites}] "
+            f"held (path: {path}) — every thread queued on the lock "
+            f"inherits the stall; move the blocking work outside the "
+            f"critical section",
+            symbol=_qualname_of(fn.mod, node)))
+
+    # one shared memo: every (function, lockset) context is walked once
+    # across the all-functions sweep, keeping the pass linear
+    memo: set = set()
+    for fi in sorted(project.functions.values(),
+                     key=lambda f: (f.mod.relpath, f.node.lineno)):
+        project.walk_from(fi, visit, seen=memo)
+    return _dedupe(out)
+
+
+# ---------------------------------------------------------------------------
+# RT010 shared-state-without-common-lock
+
+
+def check_shared_state_locksets(project: Project) -> list[Finding]:
+    """Shared state written from thread-root call chains whose guarding
+    locksets have an EMPTY intersection. Tracked state: module-level
+    names (container mutations AND bare rebinds — the check-then-set lazy
+    singleton is the motivating shape) and instance-attribute *container*
+    mutations outside ``__init__`` (scalar instance rebinds are
+    GIL-atomic publish/handoff idioms and stay exempt). All inferred
+    roots count as multi-instance: two REST handler threads, two
+    executor workers, or two job threads already race each other, so one
+    unguarded write site is enough."""
+    roots = project.thread_roots()
+    if not roots:
+        return []
+    # key → list of (lockset, node, mod, root_label)
+    writes: dict[tuple, list] = {}
+    mutables_by_mod = {module_name_of(m.relpath): _module_mutables(m)
+                       for m in project.modules}
+    globals_cache: dict[int, set] = {}
+
+    def globals_of(fn_node) -> set:
+        g = globals_cache.get(id(fn_node))
+        if g is None:
+            g = {n for stmt in ast.walk(fn_node)
+                 if isinstance(stmt, ast.Global) for n in stmt.names}
+            globals_cache[id(fn_node)] = g
+        return g
+
+    def classify(fn: FuncInfo, node) -> tuple | None:
+        mod_name = module_name_of(fn.mod.relpath)
+        in_init = fn.qualname.endswith("__init__")
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                # module global rebinds need an explicit `global` decl
+                if isinstance(t, ast.Name) and \
+                        t.id in globals_of(fn.node):
+                    return ("g", mod_name, t.id)
+                if isinstance(t, ast.Subscript):
+                    base = t.value
+                    if isinstance(base, ast.Name) and \
+                            base.id in mutables_by_mod.get(mod_name, ()) \
+                            and not _locals_of(fn.node, base.id):
+                        return ("g", mod_name, base.id)
+                    dotted = _dotted(base)
+                    if dotted.startswith("self.") and \
+                            dotted.count(".") == 1 and not in_init:
+                        cls = _enclosing_class(fn.node)
+                        if cls is not None and \
+                                project._attr_is_container(
+                                    mod_name, cls.name,
+                                    dotted.split(".")[1]):
+                            return ("a", mod_name, cls.name,
+                                    dotted.split(".")[1])
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _GROW_METHODS | _SHRINK_METHODS:
+            base = node.func.value
+            if isinstance(base, ast.Name) and \
+                    base.id in mutables_by_mod.get(mod_name, ()) and \
+                    not _locals_of(fn.node, base.id):
+                return ("g", mod_name, base.id)
+            dotted = _dotted(base)
+            if dotted.startswith("self.") and dotted.count(".") == 1 and \
+                    not in_init:
+                cls = _enclosing_class(fn.node)
+                if cls is not None and project._attr_is_container(
+                        mod_name, cls.name, dotted.split(".")[1]):
+                    return ("a", mod_name, cls.name,
+                            dotted.split(".")[1])
+        return None
+
+    for root in roots:
+        def visit(fn: FuncInfo, node, locks, chain, _root=root):
+            key = classify(fn, node)
+            if key is None:
+                return
+            writes.setdefault(key, []).append(
+                (frozenset(locks), node, fn.mod, _root.label))
+        # spawns are NOT followed here: a write after a Thread/submit
+        # boundary belongs to the SPAWNED root (walked separately), and
+        # attributing it to the spawner would flag per-instance state a
+        # job thread confines to itself (Job.results)
+        project.walk_from(root.fn, visit, follow_spawns=False)
+
+    out: list[Finding] = []
+    for key, recs in sorted(writes.items()):
+        locksets = [r[0] for r in recs]
+        common = frozenset.intersection(*locksets) if locksets else frozenset()
+        if common:
+            continue
+        kind = key[0]
+        if kind == "a":
+            # instance attrs: require two DISTINCT root functions — a
+            # single root writing its own per-instance state (Job.results
+            # from the job's own thread) is confinement, not sharing
+            if len({r[3] for r in recs}) < 2:
+                continue
+        recs.sort(key=lambda r: (r[2].relpath, r[1].lineno))
+        node, mod = recs[0][1], recs[0][2]
+        name = key[2] if kind == "g" else f"{key[2]}.{key[3]}"
+        root_labels = sorted({r[3] for r in recs})
+        seen_sets = sorted({("{" + ", ".join(sorted(s)) + "}") if s
+                            else "{}" for s, *_ in recs})
+        out.append(_finding(
+            mod, "RT010", node,
+            f"shared state {name!r} is written from thread root(s) "
+            f"{', '.join(root_labels)} with no common lock (locksets "
+            f"seen: {', '.join(seen_sets)}) — writes race; guard every "
+            f"write site with one lock",
+            symbol=_qualname_of(mod, node)))
+    return _dedupe(out)
+
+
+def _locals_of(fn_node, name: str) -> set[str]:
+    """{name} when ``name`` is function-local in ``fn_node`` (assigned
+    without a ``global`` declaration), else empty."""
+    declared_global = any(isinstance(n, ast.Global) and name in n.names
+                          for n in ast.walk(fn_node))
+    if declared_global:
+        return set()
+    assigned = any(isinstance(n, ast.Name) and n.id == name
+                   and isinstance(n.ctx, ast.Store)
+                   for n in ast.walk(fn_node))
+    return {name} if assigned else set()
+
+
+# ---------------------------------------------------------------------------
+# RT011 unbounded-growth-on-request-path
+
+
+def check_unbounded_growth(project: Project) -> list[Finding]:
+    """A long-lived container (module global or instance attribute
+    assigned in ``__init__``) that GROWS on a REST-request-reachable path
+    — through thread/executor spawns, the way a submitted job is request
+    work — with no shrink operation anywhere in the project and no
+    construction-time bound (``deque(maxlen=…)``, ``Queue(maxsize=…)``):
+    memory scales with requests served, the classic serving slow leak."""
+    roots = [r for r in project.thread_roots() if r.kind == "rest-handler"]
+    if not roots:
+        return []
+
+    # --- candidate containers and their project-wide grow/shrink sites
+    grows: dict[tuple, list] = {}     # key → [(node, mod, chain)]
+    shrinks: set = set()
+    bounded: set = set()
+
+    def container_key(fn: FuncInfo, base: ast.AST):
+        mod_name = module_name_of(fn.mod.relpath)
+        if isinstance(base, ast.Name):
+            if base.id in _module_mutables(fn.mod) and \
+                    not _locals_of(fn.node, base.id):
+                return ("g", mod_name, base.id)
+            return None
+        dotted = _dotted(base)
+        if dotted.startswith("self.") and dotted.count(".") == 1:
+            cls = _enclosing_class(fn.node)
+            if cls is not None and project._attr_is_container(
+                    mod_name, cls.name, dotted.split(".")[1]):
+                return ("a", mod_name, cls.name, dotted.split(".")[1])
+        return None
+
+    # project-wide shrink/bound scan (not just request-reachable paths:
+    # an evictor on ANY path bounds the container)
+    for m in project.modules:
+        mod_name = module_name_of(m.relpath)
+        for node in ast.walk(m.tree):
+            fn_node = _enclosing_def(node)
+            if fn_node is None:
+                continue
+            fi = project.functions.get(
+                (m.relpath, _qualname_of(m, fn_node)))
+            if fi is None:
+                continue
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SHRINK_METHODS:
+                key = container_key(fi, node.func.value)
+                if key is not None:
+                    shrinks.add(key)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        key = container_key(fi, t.value)
+                        if key is not None:
+                            shrinks.add(key)
+            elif isinstance(node, ast.Assign):
+                # re-assigning the slot outside __init__ resets/trims it
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            _dotted(t).startswith("self.") and \
+                            not fi.qualname.endswith("__init__"):
+                        cls = _enclosing_class(fi.node)
+                        if cls is not None:
+                            shrinks.add(("a", mod_name, cls.name, t.attr))
+                    elif isinstance(t, ast.Name) and fn_node is not None \
+                            and _locals_of(fn_node, t.id) == set() and \
+                            any(isinstance(g, ast.Global)
+                                and t.id in g.names
+                                for g in ast.walk(fn_node)):
+                        shrinks.add(("g", mod_name, t.id))
+
+    # construction-time bounds (Assign AND AnnAssign — the module-level
+    # ring idiom is ``_RECENT: deque = deque(maxlen=64)``)
+    for m in project.modules:
+        mod_name = module_name_of(m.relpath)
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if not isinstance(value, ast.Call):
+                continue
+            call = value
+            has_bound = any(kw.arg in _BOUND_KWARGS
+                            for kw in call.keywords) or \
+                (_dotted(call.func).split(".")[-1] == "deque"
+                 and len(call.args) >= 2) or \
+                (_dotted(call.func).split(".")[-1].endswith("Queue")
+                 and bool(call.args))
+            if not has_bound:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and \
+                        isinstance(_parent(node), ast.Module):
+                    bounded.add(("g", mod_name, t.id))
+                elif isinstance(t, ast.Attribute) and \
+                        _dotted(t).startswith("self."):
+                    fn_node = _enclosing_def(node)
+                    cls = _enclosing_class(fn_node) if fn_node else None
+                    if cls is not None:
+                        bounded.add(("a", mod_name, cls.name, t.attr))
+
+    # request-reachable grow sites. AugAssign is NOT growth: x[k] += 1
+    # updates an existing cell (a missing key raises on the read) — the
+    # module-level [0]-counter idiom must stay clean.
+    for root in roots:
+        def visit(fn: FuncInfo, node, locks, chain, _root=root):
+            key = None
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _GROW_METHODS:
+                key = container_key(fn, node.func.value)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        key = container_key(fn, t.value)
+            if key is not None:
+                grows.setdefault(key, []).append((node, fn.mod, chain))
+        project.walk_from(root.fn, visit, follow_spawns=True)
+
+    out: list[Finding] = []
+    for key, sites in sorted(grows.items()):
+        if key in shrinks or key in bounded:
+            continue
+        sites.sort(key=lambda s: (s[1].relpath, s[0].lineno))
+        node, mod, chain = sites[0]
+        name = key[2] if key[0] == "g" else f"{key[2]}.{key[3]}"
+        out.append(_finding(
+            mod, "RT011", node,
+            f"{name!r} grows on a request-reachable path (path: "
+            f"{_chain_str(chain)}) and nothing in the project ever "
+            f"shrinks or bounds it — memory scales with requests served; "
+            f"add an eviction policy, a cap, or a bounded container",
+            symbol=_qualname_of(mod, node)))
+    return _dedupe(out)
+
+
+# ---------------------------------------------------------------------------
+# interprocedural RT001 env-not-in-cache-key
+
+
+def check_env_in_cache_key_project(project: Project) -> list[Finding]:
+    """The RT001 walk, project-wide: an env read reachable from an
+    ``lru_cache``'d function through ANY resolvable call chain — module
+    helpers (the PR 4 scope) and now cross-module helpers too (the
+    ``utils/config`` idiom). Calls into OTHER cached factories are not
+    followed: the callee's own cache key is its own rule instance."""
+    out: list[Finding] = []
+    cached = [fi for fi in project.functions.values()
+              if _is_cached_def(fi.node)]
+
+    for root in sorted(cached, key=lambda f: (f.mod.relpath,
+                                              f.node.lineno)):
+        seen_nodes: set = set()
+
+        def visit(fn: FuncInfo, node, locks, chain, _root=root):
+            var = _env_read_var(node)
+            if var is None or id(node) in seen_nodes:
+                return
+            seen_nodes.add(id(node))
+            label = var or "<dynamic>"
+            where = ""
+            if fn.mod.relpath != _root.mod.relpath:
+                where = f" via {_chain_str(chain)}"
+            out.append(_finding(
+                fn.mod, "RT001", node,
+                f"env knob {label!r} read inside code reachable from "
+                f"lru_cache'd {_root.node.name!r}{where} — the knob is "
+                f"not part of the cache key; pass it as an argument "
+                f"instead",
+                symbol=_qualname_of(fn.mod, node)))
+
+        project.walk_from(
+            root, visit, max_depth=6,
+            follow_filter=lambda fi, _root=root: (
+                fi is _root or not _is_cached_def(fi.node)))
+    return _dedupe(out)
+
+
+# ---------------------------------------------------------------------------
+# interprocedural RT003 host-sync-in-trace
+
+
+def _host_sync_label(node: ast.Call) -> str | None:
+    if isinstance(node.func, ast.Attribute):
+        base = _dotted(node.func.value)
+        if node.func.attr in ("item", "block_until_ready") and \
+                not base.startswith(("np", "numpy")):
+            return f".{node.func.attr}() forces a device→host sync"
+        if node.func.attr in ("asarray", "array") and \
+                base in ("np", "numpy"):
+            return (f"{base}.{node.func.attr}() materialises a tracer "
+                    f"on the host")
+        if node.func.attr == "device_get":
+            return "device_get() forces a device→host sync"
+    return None
+
+
+def check_host_sync_in_trace_project(project: Project) -> list[Finding]:
+    """RT003 through call chains: a helper containing a host-sync
+    primitive called (transitively) from a jit-traced body is traced too
+    — the sync fires at trace time no matter which module the helper
+    lives in. Only plain defs are followed (a callee that is itself a
+    compiled-program factory returns a callable; it is not inlined)."""
+    out: list[Finding] = []
+
+    def plain(fi: FuncInfo) -> bool:
+        return not _is_cached_def(fi.node) and not any(
+            _is_jit_call(n) for n in ast.walk(fi.node)
+            if isinstance(n, ast.Call))
+
+    for m in project.modules:
+        for traced in _traced_defs(m):
+            root = project.functions.get(
+                (m.relpath, _qualname_of(m, traced)))
+            if root is None:
+                continue
+
+            def visit(fn: FuncInfo, node, locks, chain, _root=root):
+                if fn is _root or not isinstance(node, ast.Call):
+                    return   # the per-module rule owns the root body
+                msg = _host_sync_label(node)
+                if msg is None:
+                    return
+                out.append(_finding(
+                    fn.mod, "RT003", node,
+                    f"{msg} inside {fn.label!r}, reached from jit-traced "
+                    f"{_root.node.name!r} (path: {_chain_str(chain)}) — "
+                    f"hoist it out of the traced call chain",
+                    symbol=_qualname_of(fn.mod, node)))
+
+            project.walk_from(root, visit, max_depth=4,
+                              follow_filter=lambda fi, _r=root:
+                              fi is _r or plain(fi))
+    return _dedupe(out)
+
+
+# ---------------------------------------------------------------------------
+# interprocedural RT004 use-after-donate
+
+
+def donating_factories_project(project: Project) -> dict:
+    """(module relpath, factory name) → donated positions, for every
+    module function returning ``jax.jit(..., donate_argnums=…)`` (the
+    ledger ``instrument()`` wrapper unwrapped, as in the per-module
+    rule)."""
+    out: dict = {}
+    for fi in project.functions.values():
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Return) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            jit_call = None
+            if _is_jit_call(node.value):
+                jit_call = node.value
+            else:
+                for arg in node.value.args:
+                    if isinstance(arg, ast.Call) and _is_jit_call(arg):
+                        jit_call = arg
+                        break
+            if jit_call is None:
+                continue
+            pos = _donated_positions(jit_call)
+            if pos:
+                out[(fi.mod.relpath, fi.node.name)] = pos
+    return out
+
+
+def check_use_after_donate_project(project: Project) -> list[Finding]:
+    """RT004 through imports: a donating factory defined in ANOTHER
+    module (``from ..engine.device_sweep import _compiled_apply``) must
+    taint its call sites the same way a module-local one does. Module-
+    local bindings are owned by the per-module rule and skipped here."""
+    from .rules import _donate_flow, _donor_bindings
+
+    factories = donating_factories_project(project)
+    out: list[Finding] = []
+    for fi in sorted(project.functions.values(),
+                     key=lambda f: (f.mod.relpath, f.node.lineno)):
+        mod = fi.mod
+
+        def resolve(call, _mod=mod):
+            callee = project.resolve_call(_mod, _enclosing_def(call), call)
+            if callee is None or callee.mod is _mod:
+                return None   # same-module factories: per-module rule
+            return factories.get((callee.mod.relpath, callee.node.name))
+
+        donors = _donor_bindings(fi.node, {}, resolve=resolve)
+        out.extend(_donate_flow(mod, fi.node, donors))
+    return _dedupe(out)
+
+
+def _dedupe(findings: list[Finding]) -> list[Finding]:
+    seen, out = set(), []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out

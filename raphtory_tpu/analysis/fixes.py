@@ -1,0 +1,123 @@
+"""rtpulint --fix: mechanical autofixes for rules that have exactly one
+correct resolution.
+
+Only RT008 (unused-import) is autofixable today: removing a dead import
+cannot change behaviour (import side effects notwithstanding — a module
+imported ONLY for side effects should be ``import x  # rtpulint:
+disable=unused-import``, and pragma'd findings are never fixed).
+Fixes are idempotent: a fixed file re-scans clean, so running --fix twice
+is a no-op.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+import re
+
+from .rules import Module, _check_unused_import
+from .findings import suppressed
+
+_NAME_RE = re.compile(r"^'(?P<name>[^']+)' is imported but never used$")
+
+
+def _unused_names(mod: Module) -> dict[int, set[str]]:
+    """Import-statement lineno → bound names to drop (suppressions
+    respected — a pragma'd import is a considered exception, not a fix
+    target)."""
+    out: dict[int, set[str]] = {}
+    for f in _check_unused_import(mod):
+        if suppressed(f, mod.pragmas):
+            continue
+        m = _NAME_RE.match(f.message)
+        if m:
+            out.setdefault(f.line, set()).add(m.group("name"))
+    return out
+
+
+def _rebuild_import(node, keep: list) -> str:
+    """Source text for ``node`` with only the ``keep`` aliases."""
+    names = ", ".join(a.name + (f" as {a.asname}" if a.asname else "")
+                      for a in keep)
+    indent = " " * node.col_offset
+    if isinstance(node, ast.ImportFrom):
+        dots = "." * node.level
+        return f"{indent}from {dots}{node.module or ''} import {names}"
+    return f"{indent}import {names}"
+
+
+def fix_unused_imports(src: str, relpath: str = "<string>") -> tuple[str, int]:
+    """(new_source, names_removed). Whole statements whose every alias is
+    unused are deleted outright (their line(s) disappear); partially-dead
+    statements are rebuilt with the live aliases only. Multi-line
+    (parenthesised) imports collapse to one rebuilt line."""
+    try:
+        mod = Module(path=relpath, relpath=relpath, src=src)
+    except SyntaxError:
+        return src, 0
+    doomed = _unused_names(mod)
+    if not doomed:
+        return src, 0
+    lines = src.splitlines(keepends=True)
+    removed = 0
+    # group by (start, end): two statements can share one line
+    # (`import os; import sys`) — their surviving segments must merge
+    # into ONE replacement, not two overlapping edits (the second edit
+    # would delete the first's rebuilt line)
+    by_span: dict[tuple[int, int], list] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        drop = doomed.get(node.lineno)
+        if not drop:
+            continue
+
+        def bound_name(a, _node=node):
+            if isinstance(_node, ast.Import) and not a.asname:
+                return a.name.split(".")[0]
+            return a.asname or a.name
+
+        keep = [a for a in node.names if bound_name(a) not in drop]
+        removed += len(node.names) - len(keep)
+        end = getattr(node, "end_lineno", node.lineno)
+        by_span.setdefault((node.lineno, end), []).append((node, keep))
+    if removed == 0:
+        return src, 0
+    edits: list[tuple[int, int, list[str]]] = []
+    for (start, end), entries in by_span.items():
+        segs = [_rebuild_import(n, keep).lstrip()
+                for n, keep in entries if keep]
+        if not segs:
+            edits.append((start, end, []))
+            continue
+        raw_last = lines[end - 1]
+        nl = "\r\n" if raw_last.endswith("\r\n") else "\n"
+        indent = " " * entries[0][0].col_offset
+        # a trailing comment on the original line survives the rebuild —
+        # it may be a pragma for ANOTHER rule, or a reviewer note
+        m = re.search(r"(#.*?)\s*$", raw_last.rstrip("\r\n"))
+        comment = f"  {m.group(1)}" if m else ""
+        edits.append((start, end,
+                      [indent + "; ".join(segs) + comment + nl]))
+    for start, end, repl in sorted(edits, reverse=True):
+        lines[start - 1: end] = repl
+    return "".join(lines), removed
+
+
+def fix_files(paths_and_sources: list[tuple[str, str]]):
+    """[(path, src)] → (fixed {path: new_src}, total names removed).
+    Files that need no change are absent from the result dict."""
+    fixed: dict[str, str] = {}
+    total = 0
+    for relpath, src in paths_and_sources:
+        new, n = fix_unused_imports(src, relpath)
+        if n:
+            fixed[relpath] = new
+            total += n
+    return fixed, total
+
+
+def unified_diff(relpath: str, old: str, new: str) -> str:
+    return "".join(difflib.unified_diff(
+        old.splitlines(keepends=True), new.splitlines(keepends=True),
+        fromfile=f"a/{relpath}", tofile=f"b/{relpath}"))

@@ -1,0 +1,710 @@
+"""Project-wide interprocedural model for the rtpulint rules.
+
+The per-module AST rules (PR 4) stop at module boundaries, which is
+exactly where the serving-era bug class lives: a REST handler thread and a
+fold worker share engine state through a chain of calls that no single
+module shows. This module builds the project-level tables the
+interprocedural rules (RT009–RT011, and the cross-module halves of
+RT001/RT003/RT004) share:
+
+* **module resolution** — relpath → dotted module name, plus an alias
+  table per module covering ``import x.y as z``, ``from ..pkg import mod``
+  and ``from .mod import fn`` (function-local imports included: the repo
+  imports lazily for jax-stripped environments);
+* **call graph** — call expressions resolved to function defs across
+  modules: bare names through the local/nested/imported scopes,
+  ``alias.fn`` through module aliases, ``self.meth``/``cls.meth`` to the
+  enclosing class (never a same-named method elsewhere — the RT003
+  scoping lesson), and ``obj.meth`` when ``obj`` is constructed from a
+  resolvable class in the same function;
+* **thread roots** — where concurrency actually starts:
+  ``threading.Thread(target=…)``, ``executor.submit(…)`` (the fold pools),
+  ``threading.Timer``, ``do_GET``/``do_POST``-style handlers on
+  ``BaseHTTPRequestHandler`` subclasses (``ThreadingHTTPServer`` runs each
+  request on its own thread), and ``Gauge.set_function`` callbacks (run on
+  the metrics scrape thread);
+* **reaching locksets** — a depth-first walk from every thread root that
+  tracks the set of locks held at each statement (``with lock:`` blocks,
+  plus balanced same-function ``acquire``/``release`` pairs) THROUGH
+  calls, memoised on (function, lockset) so shared helpers are walked
+  once per distinct context.
+
+Deliberately precision-first: resolution that cannot be done confidently
+is skipped, because every false positive here costs a source fix or a
+reviewed pragma (the baseline stays empty by policy). stdlib-only, like
+the rest of the analysis package.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .rules import Module, _dotted, _parent, _enclosing_def
+
+#: resolution depth bound for call-graph walks — deep enough for the
+#: repo's real chains (REST → manager → engine → transfer is 4), bounded
+#: so a pathological cycle cannot hang the lint.
+MAX_DEPTH = 8
+
+_LOCKY_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                    "BoundedSemaphore"}
+_EXECUTOR_SUBMIT = {"submit"}
+_HANDLER_BASES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler"}
+#: container constructors that mark an attribute/global as long-lived
+#: mutable state (RT010/RT011 candidates)
+CONTAINER_FACTORIES = {"dict", "list", "set", "defaultdict", "deque",
+                       "OrderedDict", "Counter", "Queue", "LifoQueue",
+                       "PriorityQueue", "SimpleQueue", "WeakKeyDictionary",
+                       "WeakValueDictionary"}
+
+
+def module_name_of(relpath: str) -> str:
+    """``raphtory_tpu/jobs/manager.py`` → ``raphtory_tpu.jobs.manager``;
+    ``pkg/__init__.py`` → ``pkg``; extensionless scripts keep their stem
+    (``tools/rtpulint`` → ``tools.rtpulint``)."""
+    p = relpath.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.strip("/").replace("/", ".")
+
+
+@dataclass
+class FuncInfo:
+    """One function/method definition plus its project coordinates."""
+
+    mod: Module
+    node: ast.FunctionDef
+    qualname: str            # within the module, e.g. "FoldCache.get"
+    cls: str | None = None   # enclosing class name, if a method
+
+    @property
+    def key(self) -> tuple:
+        return (self.mod.relpath, self.qualname)
+
+    @property
+    def label(self) -> str:
+        return f"{module_name_of(self.mod.relpath)}.{self.qualname}"
+
+
+@dataclass
+class ThreadRoot:
+    """An inferred concurrency entry point."""
+
+    fn: FuncInfo
+    kind: str                # thread | executor | timer | rest-handler |
+    #                          scrape-callback
+    spawn_site: str = ""     # "relpath:line" of the spawning call ("" for
+    #                          handler-class roots)
+
+    @property
+    def label(self) -> str:
+        return f"{self.fn.label}[{self.kind}]"
+
+
+class Project:
+    """The resolved project: modules, functions, imports, call graph."""
+
+    def __init__(self, modules: list[Module]):
+        self.modules = modules
+        self.by_name: dict[str, Module] = {}
+        for m in modules:
+            self.by_name[module_name_of(m.relpath)] = m
+        #: (relpath, qualname) → FuncInfo
+        self.functions: dict[tuple, FuncInfo] = {}
+        #: module name → {bare name → FuncInfo} (module scope defs)
+        self.toplevel: dict[str, dict[str, FuncInfo]] = {}
+        #: module name → {class name → {method name → FuncInfo}}
+        self.classes: dict[str, dict[str, dict[str, FuncInfo]]] = {}
+        #: module name → {class name → ClassDef}
+        self.class_nodes: dict[str, dict[str, ast.ClassDef]] = {}
+        #: module name → {alias → ("module", dotted) | ("symbol", mod, nm)}
+        self.imports: dict[str, dict[str, tuple]] = {}
+        for m in modules:
+            self._index_module(m)
+        self._roots: list[ThreadRoot] | None = None
+
+    # ------------------------------------------------------------ indexing
+
+    def _index_module(self, m: Module) -> None:
+        name = module_name_of(m.relpath)
+        top: dict[str, FuncInfo] = {}
+        classes: dict[str, dict[str, FuncInfo]] = {}
+        cnodes: dict[str, ast.ClassDef] = {}
+        for node in ast.walk(m.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn, cls = _qual_and_class(node)
+                fi = FuncInfo(m, node, qn, cls)
+                self.functions[fi.key] = fi
+                parent = _parent(node)
+                if isinstance(parent, ast.Module):
+                    top[node.name] = fi
+                elif isinstance(parent, ast.ClassDef) and \
+                        isinstance(_parent(parent), ast.Module):
+                    classes.setdefault(parent.name, {})[node.name] = fi
+            elif isinstance(node, ast.ClassDef) and \
+                    isinstance(_parent(node), ast.Module):
+                cnodes[node.name] = node
+        self.toplevel[name] = top
+        self.classes[name] = classes
+        self.class_nodes[name] = cnodes
+        self.imports[name] = self._alias_table(m, name)
+
+    def _alias_table(self, m: Module, name: str) -> dict[str, tuple]:
+        """All imports in the module (function-local included — the repo
+        imports lazily), collapsed into one alias table."""
+        out: dict[str, tuple] = {}
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        out[a.asname] = ("module", a.name)
+                    else:
+                        out[a.name.split(".")[0]] = \
+                            ("module", a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                if node.level:
+                    base_parts = name.split(".")
+                    # level=1: current package; each extra level: one up.
+                    # For an __init__.py the dotted name already IS the
+                    # package, so one fewer component comes off — else
+                    # `from .mod import f` in pkg/__init__.py resolved a
+                    # level too high and every re-export chain silently
+                    # dropped out of the call graph
+                    drop = node.level
+                    if m.relpath.replace("\\", "/").endswith(
+                            "__init__.py"):
+                        drop -= 1
+                    base_parts = base_parts[: len(base_parts) - drop] \
+                        if drop else base_parts
+                    base = ".".join(base_parts)
+                else:
+                    base = ""
+                src = ".".join(p for p in (base, node.module or "") if p)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    bound = a.asname or a.name
+                    target = f"{src}.{a.name}" if src else a.name
+                    if target in self.by_name:
+                        out[bound] = ("module", target)
+                    else:
+                        out[bound] = ("symbol", src, a.name)
+        return out
+
+    # ---------------------------------------------------------- resolution
+
+    def resolve_call(self, m: Module, scope, call: ast.Call) -> FuncInfo | None:
+        """The FunctionDef a call lands in, or None when resolution is not
+        confident. ``scope`` is the enclosing FunctionDef (or None at
+        module level)."""
+        return self.resolve_target(m, scope, call.func)
+
+    def resolve_target(self, m: Module, scope, func: ast.AST) -> FuncInfo | None:
+        name = module_name_of(m.relpath)
+        if isinstance(func, ast.Name):
+            return self._resolve_bare(m, name, scope, func.id)
+        if not isinstance(func, ast.Attribute):
+            return None
+        dotted = _dotted(func)
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        if parts[0] in ("self", "cls") and len(parts) == 2:
+            cls = _enclosing_class(scope)
+            if cls is not None:
+                fi = self.classes.get(name, {}).get(cls.name, {}) \
+                    .get(parts[1])
+                if fi is not None:
+                    return fi
+                # inherited method: single-level, same-project bases only
+                for base in cls.bases:
+                    bname = _dotted(base).split(".")[-1]
+                    for mod2, cmap in self.classes.items():
+                        if bname in cmap and parts[1] in cmap[bname]:
+                            return cmap[bname][parts[1]]
+            return None
+        if parts[0] in ("self", "cls") and len(parts) == 3:
+            # self.attr.meth() — infer attr's class from a class-level
+            # annotation (`manager: AnalysisManager = None`, the REST
+            # handler injection idiom) or an `__init__` assignment
+            # (`self.graph = TemporalGraph(...)`)
+            cls = _enclosing_class(scope)
+            if cls is not None:
+                hit = self._attr_class_of(name, cls, parts[1])
+                if hit is not None:
+                    mod2, cname = hit
+                    return self.classes.get(mod2, {}).get(cname, {}) \
+                        .get(parts[2])
+            return None
+        binding = self.imports.get(name, {}).get(parts[0])
+        if binding is not None and binding[0] == "module":
+            target_mod = binding[1]
+            rest = parts[1:]
+            # walk submodules as far as they exist
+            while len(rest) > 1 and f"{target_mod}.{rest[0]}" in self.by_name:
+                target_mod = f"{target_mod}.{rest[0]}"
+                rest = rest[1:]
+            if len(rest) == 1:
+                fi = self.toplevel.get(target_mod, {}).get(rest[0])
+                if fi is not None:
+                    return fi
+            if len(rest) == 2:   # alias.Class.method (rare but cheap)
+                fi = self.classes.get(target_mod, {}).get(rest[0], {}) \
+                    .get(rest[1])
+                if fi is not None:
+                    return fi
+            return None
+        # obj.meth where obj is a local constructed from a resolvable
+        # class in the same function:  eng = TransferEngine(...); eng.put()
+        if scope is not None and len(parts) == 2:
+            cls_fi = self._local_class_of(m, name, scope, parts[0])
+            if cls_fi is not None:
+                mod2, cname = cls_fi
+                return self.classes.get(mod2, {}).get(cname, {}) \
+                    .get(parts[1])
+        return None
+
+    def _resolve_bare(self, m: Module, name: str, scope,
+                      bare: str) -> FuncInfo | None:
+        # nested def in the enclosing function chain wins
+        cur = scope
+        while cur is not None:
+            for node in ast.walk(cur):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        node.name == bare and _enclosing_def(node) is cur:
+                    return self.functions.get((m.relpath,
+                                               _qual_and_class(node)[0]))
+            cur = _enclosing_def(cur)
+        fi = self.toplevel.get(name, {}).get(bare)
+        if fi is not None:
+            return fi
+        binding = self.imports.get(name, {}).get(bare)
+        if binding is not None:
+            if binding[0] == "symbol":
+                _, mod2, nm = binding
+                fi = self.toplevel.get(mod2, {}).get(nm)
+                if fi is not None:
+                    return fi
+                # imported class: a call constructs it — resolve __init__
+                if nm in self.classes.get(mod2, {}):
+                    return self.classes[mod2][nm].get("__init__")
+            elif binding[0] == "module":
+                return None
+        # class constructed by bare name in this module
+        if bare in self.classes.get(name, {}):
+            return self.classes[name][bare].get("__init__")
+        return None
+
+    def _attr_class_of(self, mod_name: str, cls: ast.ClassDef,
+                       attr: str) -> tuple | None:
+        """(module, class) of ``self.<attr>`` on ``cls``, from a class-
+        level annotation or a single unambiguous ``__init__``
+        construction."""
+
+        def resolve_cname(cname: str) -> tuple | None:
+            if cname in self.classes.get(mod_name, {}) or \
+                    cname in self.class_nodes.get(mod_name, {}):
+                return (mod_name, cname)
+            binding = self.imports.get(mod_name, {}).get(cname)
+            if binding is not None and binding[0] == "symbol" and \
+                    binding[2] in self.classes.get(binding[1], {}):
+                return (binding[1], binding[2])
+            return None
+
+        for stmt in cls.body:
+            if isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name) and \
+                    stmt.target.id == attr:
+                cname = _dotted(stmt.annotation).split(".")[-1]
+                hit = resolve_cname(cname)
+                if hit is not None:
+                    return hit
+        init = self.classes.get(mod_name, {}).get(cls.name, {}) \
+            .get("__init__")
+        if init is not None:
+            found = None
+            for node in ast.walk(init.node):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and any(
+                            isinstance(t, ast.Attribute) and
+                            t.attr == attr and _dotted(t.value) == "self"
+                            for t in node.targets):
+                    hit = resolve_cname(
+                        _dotted(node.value.func).split(".")[-1])
+                    if hit is None:
+                        return None
+                    if found is not None and found != hit:
+                        return None
+                    found = hit
+            return found
+        return None
+
+    def _local_class_of(self, m: Module, name: str, scope,
+                        var: str) -> tuple | None:
+        """(module, class) the local ``var`` was constructed from, when a
+        single unambiguous ``var = ClassName(...)`` exists in ``scope``."""
+        found = None
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    node.targets[0].id == var:
+                if not isinstance(node.value, ast.Call):
+                    return None
+                cname = _dotted(node.value.func).split(".")[-1]
+                hit = None
+                if cname in self.classes.get(name, {}):
+                    hit = (name, cname)
+                else:
+                    binding = self.imports.get(name, {}).get(cname)
+                    if binding is not None and binding[0] == "symbol" and \
+                            cname in self.classes.get(binding[1], {}):
+                        hit = (binding[1], cname)
+                if hit is None:
+                    return None
+                if found is not None and found != hit:
+                    return None   # ambiguous rebinding
+                found = hit
+        return found
+
+    # --------------------------------------------------------- thread roots
+
+    def thread_roots(self) -> list[ThreadRoot]:
+        """Every inferred concurrency entry point. All roots are treated
+        as multi-instance: REST handlers run per connection, executors
+        run per submit, and the repo spawns its job/ingest threads in
+        loops — two instances of one root already race each other."""
+        if self._roots is not None:
+            return self._roots
+        roots: dict[tuple, ThreadRoot] = {}
+
+        def add(fi: FuncInfo | None, kind: str, site: str) -> None:
+            if fi is not None:
+                roots.setdefault((fi.key, kind),
+                                 ThreadRoot(fi, kind, site))
+
+        for m in self.modules:
+            name = module_name_of(m.relpath)
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Call):
+                    scope = _enclosing_def(node)
+                    site = f"{m.relpath}:{getattr(node, 'lineno', 0)}"
+                    callee = _dotted(node.func)
+                    tail = callee.split(".")[-1]
+                    if tail in ("Thread", "Timer"):
+                        target = _kwarg(node, "target")
+                        if target is None and tail == "Timer" and \
+                                len(node.args) >= 2:
+                            target = node.args[1]
+                        add(self._as_func(m, scope, target),
+                            "thread" if tail == "Thread" else "timer", site)
+                    elif tail in _EXECUTOR_SUBMIT and node.args:
+                        add(self._as_func(m, scope, node.args[0]),
+                            "executor", site)
+                    elif tail == "set_function" and node.args:
+                        add(self._as_func(m, scope, node.args[0]),
+                            "scrape-callback", site)
+            # request-handler classes: each do_* method is a root
+            for cname, cnode in self.class_nodes.get(name, {}).items():
+                if not self._is_handler_class(name, cnode):
+                    continue
+                for meth, fi in self.classes[name].get(cname, {}).items():
+                    if meth.startswith("do_"):
+                        add(fi, "rest-handler", "")
+        self._roots = sorted(roots.values(), key=lambda r: r.label)
+        return self._roots
+
+    def _is_handler_class(self, mod_name: str, cnode: ast.ClassDef,
+                          depth: int = 0) -> bool:
+        for base in cnode.bases:
+            bname = _dotted(base).split(".")[-1]
+            if bname in _HANDLER_BASES:
+                return True
+            if depth < 3:
+                parent = self.class_nodes.get(mod_name, {}).get(bname)
+                if parent is None:
+                    binding = self.imports.get(mod_name, {}).get(bname)
+                    if binding is not None and binding[0] == "symbol":
+                        parent = self.class_nodes.get(binding[1], {}) \
+                            .get(binding[2])
+                        mod_name2 = binding[1]
+                    else:
+                        parent, mod_name2 = None, mod_name
+                else:
+                    mod_name2 = mod_name
+                if parent is not None and \
+                        self._is_handler_class(mod_name2, parent, depth + 1):
+                    return True
+        return False
+
+    def _as_func(self, m: Module, scope, expr) -> FuncInfo | None:
+        if expr is None:
+            return None
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return self.resolve_target(m, scope, expr)
+        if isinstance(expr, ast.Lambda):
+            return None
+        return None
+
+    # ------------------------------------------------------------- walking
+
+    def walk_from(self, start: FuncInfo, visit,
+                  lockset: frozenset = frozenset(),
+                  follow_spawns: bool = False, max_depth: int = MAX_DEPTH,
+                  follow_filter=None, seen: set | None = None):
+        """Depth-first interprocedural walk from ``start``.
+
+        ``visit(fn, node, lockset, chain)`` is called for every AST node
+        of every function reached, with the lockset held at that node and
+        the call chain (tuple of FuncInfo) that got there. Memoised on
+        (function, lockset): a helper reached under two different locksets
+        is walked once per distinct context; pass a shared ``seen`` set to
+        extend the memo across walks (how the RT009 all-functions sweep
+        stays linear). ``follow_filter(callee) -> bool`` vetoes descent
+        into particular callees (RT001 does not enter other cached
+        factories — their cache key is their own rule instance). When
+        ``follow_spawns`` is true, thread/executor targets spawned along
+        the way are walked too, with an EMPTY lockset — the new thread
+        holds nothing — which is how "request-reachable" crosses the
+        submit-a-job boundary."""
+        if seen is None:
+            seen = set()
+
+        def go(fn: FuncInfo, locks: frozenset, chain: tuple, depth: int):
+            if depth > max_depth or (fn.key, locks) in seen:
+                return
+            if chain and follow_filter is not None and \
+                    not follow_filter(fn):
+                return
+            seen.add((fn.key, locks))
+            chain = chain + (fn,)
+            self._walk_body(fn, list(fn.node.body), locks, chain, visit,
+                            go, depth, follow_spawns)
+
+        go(start, lockset, (), 0)
+
+    def _walk_body(self, fn: FuncInfo, stmts, locks: frozenset, chain,
+                   visit, go, depth: int, follow_spawns: bool) -> None:
+        """Statement-structured walk: each expression node is visited
+        exactly once, with the lockset actually held at that statement.
+        Explicit ``X.acquire()``/``X.release()`` statements adjust the set
+        for the REST of the enclosing body (cross-function hand-offs —
+        acquire here, release in the caller — are out of scope and stay
+        invisible, documented in docs/STATIC_ANALYSIS.md)."""
+        args = (chain, visit, go, depth, follow_spawns)
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue   # nested defs are walked when called
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                new = set(locks)
+                for item in stmt.items:
+                    self._visit_expr(fn, item.context_expr, locks, *args)
+                    lid = self._lock_id(fn, item.context_expr)
+                    if lid is not None:
+                        new.add(lid)
+                self._walk_body(fn, stmt.body, frozenset(new), *args)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._visit_expr(fn, stmt.iter, locks, *args)
+                self._walk_body(fn, stmt.body + stmt.orelse, locks, *args)
+                continue
+            if isinstance(stmt, ast.While):
+                self._visit_expr(fn, stmt.test, locks, *args)
+                self._walk_body(fn, stmt.body + stmt.orelse, locks, *args)
+                continue
+            if isinstance(stmt, ast.If):
+                self._visit_expr(fn, stmt.test, locks, *args)
+                self._walk_body(fn, stmt.body + stmt.orelse, locks, *args)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._walk_body(fn, stmt.body, locks, *args)
+                for h in stmt.handlers:
+                    self._walk_body(fn, h.body, locks, *args)
+                self._walk_body(fn, stmt.orelse, locks, *args)
+                self._walk_body(fn, stmt.finalbody, locks, *args)
+                continue
+            # explicit acquire()/release() as bare statements
+            if isinstance(stmt, ast.Expr) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    isinstance(stmt.value.func, ast.Attribute) and \
+                    stmt.value.func.attr in ("acquire", "release"):
+                lid = self._lock_id(fn, stmt.value.func.value)
+                if lid is not None:
+                    locks = (locks | {lid}
+                             if stmt.value.func.attr == "acquire"
+                             else locks - {lid})
+                self._visit_expr(fn, stmt, locks, *args)
+                continue
+            self._visit_expr(fn, stmt, locks, *args)
+
+    def _visit_expr(self, fn: FuncInfo, node: ast.AST, locks: frozenset,
+                    chain, visit, go, depth: int,
+                    follow_spawns: bool) -> None:
+        for sub in ast.walk(node):
+            visit(fn, sub, locks, chain)
+            if isinstance(sub, ast.Call):
+                self._follow_call(fn, sub, locks, chain, go, depth,
+                                  follow_spawns)
+
+    def _follow_call(self, fn: FuncInfo, node: ast.Call, locks, chain, go,
+                     depth: int, follow_spawns: bool) -> None:
+        callee = self.resolve_call(fn.mod, _enclosing_def(node), node)
+        if callee is not None and callee.node is not fn.node:
+            go(callee, locks, chain, depth + 1)
+        if follow_spawns:
+            tail = _dotted(node.func).split(".")[-1]
+            target = None
+            if tail in ("Thread", "Timer"):
+                target = _kwarg(node, "target")
+                if target is None and tail == "Timer" and \
+                        len(node.args) >= 2:
+                    target = node.args[1]
+            elif tail in _EXECUTOR_SUBMIT and node.args:
+                target = node.args[0]
+            if target is not None:
+                tfi = self._as_func(fn.mod, _enclosing_def(node), target)
+                if tfi is not None:
+                    # the spawned thread starts with nothing held
+                    go(tfi, frozenset(), chain, depth + 1)
+
+    # ------------------------------------------------------------ lock ids
+
+    def _lock_id(self, fn: FuncInfo, expr: ast.AST) -> str | None:
+        """Stable identity for a lock expression, or None when the
+        expression is not confidently a lock. ``module.NAME`` for module
+        globals, ``module.Class.attr`` for instance locks."""
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+            # with lock_for(x): … — a call RETURNING a lock: identify by
+            # the callee (one id per factory — conservative but stable)
+            dotted = _dotted(expr)
+            if dotted and _looks_locky(dotted.split(".")[-1]):
+                return f"{fn.mod.relpath}:{dotted}()"
+            return None
+        dotted = _dotted(expr)
+        if not dotted:
+            return None
+        name = module_name_of(fn.mod.relpath)
+        parts = dotted.split(".")
+        if parts[0] in ("self", "cls") and len(parts) == 2:
+            cls = _enclosing_class(fn.node)
+            cname = cls.name if cls is not None else "?"
+            if _looks_locky(parts[1]) or self._attr_is_lock(name, cname,
+                                                            parts[1]):
+                return f"{name}.{cname}.{parts[1]}"
+            return None
+        if len(parts) == 1:
+            if self._global_is_lock(name, parts[0]):
+                return f"{name}.{parts[0]}"
+            if _looks_locky(parts[0]):
+                # a local bound to a lock (lock = self._mu; with lock:) —
+                # identify per function, best effort
+                return f"{fn.mod.relpath}:{fn.qualname}:{parts[0]}"
+            return None
+        if _looks_locky(parts[-1]):
+            return f"{name}.{dotted}"
+        return None
+
+    def _global_is_lock(self, mod_name: str, var: str) -> bool:
+        m = self.by_name.get(mod_name)
+        if m is None:
+            return False
+        for stmt in getattr(m.tree, "body", []):
+            if isinstance(stmt, ast.Assign) and \
+                    any(isinstance(t, ast.Name) and t.id == var
+                        for t in stmt.targets) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    _dotted(stmt.value.func).split(".")[-1] in \
+                    _LOCKY_FACTORIES:
+                return True
+        return False
+
+    def _attr_is_container(self, mod_name: str, cname: str,
+                           attr: str) -> bool:
+        """True when ``self.<attr>`` is assigned a mutable container in
+        the class's ``__init__`` — the long-lived-state candidate set for
+        RT010/RT011."""
+        init = self.classes.get(mod_name, {}).get(cname, {}).get("__init__")
+        if init is None:
+            return False
+        for node in ast.walk(init.node):
+            targets, value = [], None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            is_container = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                              ast.ListComp, ast.DictComp,
+                                              ast.SetComp))
+            if isinstance(value, ast.Call):
+                is_container = _dotted(value.func).split(".")[-1] in \
+                    CONTAINER_FACTORIES
+            if not is_container:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr == attr and \
+                        _dotted(t.value) == "self":
+                    return True
+        return False
+
+    def _attr_is_lock(self, mod_name: str, cname: str, attr: str) -> bool:
+        init = self.classes.get(mod_name, {}).get(cname, {}).get("__init__")
+        if init is None:
+            return False
+        for node in ast.walk(init.node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _dotted(node.value.func).split(".")[-1] in \
+                    _LOCKY_FACTORIES:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == attr and \
+                            _dotted(t.value) == "self":
+                        return True
+        return False
+
+
+# ---------------------------------------------------------------- helpers
+
+
+def _kwarg(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _looks_locky(name: str) -> bool:
+    low = name.lower()
+    return ("lock" in low or "mutex" in low or low in ("_mu", "mu", "cv")
+            or "cond" in low)
+
+
+def _qual_and_class(node) -> tuple[str, str | None]:
+    names, cls = [], None
+    cur = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            names.append(cur.name)
+            if isinstance(cur, ast.ClassDef) and cls is None and \
+                    cur is not node:
+                cls = cur.name
+        cur = _parent(cur)
+    return ".".join(reversed(names)), cls
+
+
+def _enclosing_class(scope) -> ast.ClassDef | None:
+    cur = scope
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = _parent(cur)
+    return None

@@ -32,6 +32,9 @@ RULES = {
     "RT006": "unguarded-module-state",
     "RT007": "undocumented-knob",
     "RT008": "unused-import",
+    "RT009": "blocking-call-under-lock",
+    "RT010": "shared-state-without-common-lock",
+    "RT011": "unbounded-growth-on-request-path",
 }
 
 _ENV_VAR_RE = re.compile(r"^RTPU_[A-Z0-9_]+$")
@@ -240,50 +243,9 @@ def _calls_sleep(tree: ast.AST) -> bool:
 
 
 # ---------------------------------------------------------------------------
-# RT001 env-not-in-cache-key
-
-
-def _check_env_not_in_cache_key(mod: Module) -> list[Finding]:
-    """An env/config read reachable from an ``lru_cache``'d function: the
-    knob's value influences the cached result but is absent from the cache
-    key (the argument tuple), so flipping the env var mid-process silently
-    reuses programs built for the old value — the RTPU_TILE_BUDGET_MB bug."""
-    out = []
-    cached = [f for fns in mod.functions.values() for f in fns
-              if _is_cached_def(f)]
-    for root in cached:
-        # walk the cached function's subtree, following calls to other
-        # module-scope functions (the factory-helper idiom), bounded depth
-        seen = {id(root)}
-        frontier = [root]
-        depth = 0
-        while frontier and depth < 6:
-            nxt = []
-            for fn in frontier:
-                for node in ast.walk(fn):
-                    var = _env_read_var(node)
-                    if var is not None:
-                        label = var or "<dynamic>"
-                        out.append(mod.finding(
-                            "RT001", node,
-                            f"env knob {label!r} read inside code reachable "
-                            f"from lru_cache'd {root.name!r} — the knob is "
-                            f"not part of the cache key; pass it as an "
-                            f"argument instead"))
-                    if isinstance(node, ast.Call):
-                        callee = _dotted(node.func)
-                        for cand in mod.functions.get(
-                                callee.split(".")[-1], []):
-                            # only follow plain helpers, not other factories
-                            if id(cand) not in seen and callee and \
-                                    not _is_cached_def(cand):
-                                seen.add(id(cand))
-                                nxt.append(cand)
-            frontier = nxt
-            depth += 1
-    return out
-
-
+# RT001 env-not-in-cache-key lives in concurrency.py now: the walk is the
+# project-wide interprocedural one (module helpers AND cross-module
+# helpers), run by both analyze_module and analyze_project.
 # ---------------------------------------------------------------------------
 # RT002 broad-except-retry
 
@@ -436,67 +398,87 @@ def _donated_positions(call: ast.Call):
     return None
 
 
+def _donor_bindings(fn, factories, resolve=None) -> dict[str, set]:
+    """Donating callables bound inside ``fn``:
+    ``f = jax.jit(..., donate_argnums=…)`` | ``f = _compiled_apply(…)``.
+    ``resolve(call)`` (optional) maps a call to donated positions through
+    project-level resolution — the cross-module factory case."""
+    donors: dict[str, set] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and \
+                len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call):
+            call = node.value
+            pos = None
+            if _is_jit_call(call):
+                pos = _donated_positions(call)
+            else:
+                callee = _dotted(call.func).split(".")[-1]
+                pos = factories.get(callee)
+                if pos is None and resolve is not None:
+                    pos = resolve(call)
+            if pos:
+                donors[node.targets[0].id] = pos
+    return donors
+
+
+def _donate_flow(mod: Module, fn, donors: dict[str, set]) -> list[Finding]:
+    """The read-after-donate dataflow over one function body, shared by
+    the per-module rule and the project-level (cross-module factory)
+    variant in concurrency.py."""
+    out: list[Finding] = []
+    if not donors:
+        return out
+    # name → sorted store linenos, for the staleness check
+    stores: dict[str, list[int]] = {}
+    loads: dict[str, list[ast.Name]] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                stores.setdefault(node.id, []).append(node.lineno)
+            else:
+                loads.setdefault(node.id, []).append(node)
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in donors):
+            continue
+        for idx in sorted(donors[node.func.id]):
+            if idx >= len(node.args):
+                continue
+            arg = node.args[idx]
+            if not isinstance(arg, ast.Name):
+                continue   # *starred / attribute args: can't track
+            for use in loads.get(arg.id, []):
+                if use.lineno <= node.lineno or use is arg:
+                    continue
+                # a store on the call line itself is the
+                # ``x = f(x, …)`` rebind idiom — fresh value
+                if any(node.lineno <= s <= use.lineno
+                       for s in stores.get(arg.id, [])):
+                    continue   # rebound in between — fresh value
+                out.append(mod.finding(
+                    "RT004", use,
+                    f"{arg.id!r} is read after being donated to "
+                    f"{node.func.id!r} (arg {idx}) on line "
+                    f"{node.lineno} — its buffer may already be "
+                    f"reused; copy first or re-order"))
+    return out
+
+
 def _check_use_after_donate(mod: Module) -> list[Finding]:
     """Reading a variable after passing it at a donated position: XLA has
     already reused its buffer, so the read returns garbage (TPU) or raises
-    a deleted-buffer error — either way, after an arbitrary delay."""
-    out = []
+    a deleted-buffer error — either way, after an arbitrary delay.
+    Module-local factories only; cross-module factories are resolved by
+    the project-level variant (concurrency.py)."""
+    out: list[Finding] = []
     factories = _donating_factories(mod)
     for fns in mod.functions.values():
         for fn in fns:
-            # donating callables bound inside this function:
-            # f = jax.jit(..., donate_argnums=…)  |  f = _compiled_apply(…)
-            donors: dict[str, set] = {}
-            for node in ast.walk(fn):
-                if isinstance(node, ast.Assign) and \
-                        len(node.targets) == 1 and \
-                        isinstance(node.targets[0], ast.Name) and \
-                        isinstance(node.value, ast.Call):
-                    call = node.value
-                    pos = None
-                    if _is_jit_call(call):
-                        pos = _donated_positions(call)
-                    else:
-                        callee = _dotted(call.func).split(".")[-1]
-                        pos = factories.get(callee)
-                    if pos:
-                        donors[node.targets[0].id] = pos
-            if not donors:
-                continue
-            # name → sorted store linenos, for the staleness check
-            stores: dict[str, list[int]] = {}
-            loads: dict[str, list[ast.Name]] = {}
-            for node in ast.walk(fn):
-                if isinstance(node, ast.Name):
-                    if isinstance(node.ctx, (ast.Store, ast.Del)):
-                        stores.setdefault(node.id, []).append(node.lineno)
-                    else:
-                        loads.setdefault(node.id, []).append(node)
-            for node in ast.walk(fn):
-                if not (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Name)
-                        and node.func.id in donors):
-                    continue
-                for idx in sorted(donors[node.func.id]):
-                    if idx >= len(node.args):
-                        continue
-                    arg = node.args[idx]
-                    if not isinstance(arg, ast.Name):
-                        continue   # *starred / attribute args: can't track
-                    for use in loads.get(arg.id, []):
-                        if use.lineno <= node.lineno or use is arg:
-                            continue
-                        # a store on the call line itself is the
-                        # ``x = f(x, …)`` rebind idiom — fresh value
-                        if any(node.lineno <= s <= use.lineno
-                               for s in stores.get(arg.id, [])):
-                            continue   # rebound in between — fresh value
-                        out.append(mod.finding(
-                            "RT004", use,
-                            f"{arg.id!r} is read after being donated to "
-                            f"{node.func.id!r} (arg {idx}) on line "
-                            f"{node.lineno} — its buffer may already be "
-                            f"reused; copy first or re-order"))
+            out.extend(_donate_flow(mod, fn,
+                                    _donor_bindings(fn, factories)))
     return out
 
 
@@ -666,34 +648,90 @@ def check_undocumented_knobs(modules: list[Module], docs_text: str,
 # ---------------------------------------------------------------------------
 # drivers
 
-_MODULE_CHECKS = [
-    _check_env_not_in_cache_key,
-    _check_broad_except_retry,
-    _check_host_sync_in_trace,
-    _check_use_after_donate,
-    _check_nondeterminism_in_trace,
-    _check_unguarded_module_state,
-    _check_unused_import,
-]
+#: per-module passes, keyed by the rule id they implement (the key is the
+#: timing bucket — RT003/RT004 also have project-level halves that land
+#: in the same bucket)
+_MODULE_CHECKS = {
+    "RT002": _check_broad_except_retry,
+    "RT003": _check_host_sync_in_trace,
+    "RT004": _check_use_after_donate,
+    "RT005": _check_nondeterminism_in_trace,
+    "RT006": _check_unguarded_module_state,
+    "RT008": _check_unused_import,
+}
+
+
+def _project_checks():
+    """Rule id → project-level pass. Imported lazily: concurrency.py
+    imports this module's helpers, so a top-level import would cycle."""
+    from . import concurrency as cc
+
+    return {
+        "RT001": cc.check_env_in_cache_key_project,
+        "RT003": cc.check_host_sync_in_trace_project,
+        "RT004": cc.check_use_after_donate_project,
+        "RT009": cc.check_blocking_under_lock,
+        "RT010": cc.check_shared_state_locksets,
+        "RT011": cc.check_unbounded_growth,
+    }
+
+
+def _analyze_modules(modules: list[Module],
+                     timings: dict | None = None) -> list[Finding]:
+    """Per-module + project-level passes over already-parsed modules,
+    suppressions applied. ``timings`` (optional) collects per-rule wall
+    seconds — the CI budget evidence."""
+    from time import perf_counter
+
+    from .interproc import Project
+
+    def timed(rule_id: str, fn, *args):
+        t0 = perf_counter()
+        try:
+            return fn(*args)
+        finally:
+            if timings is not None:
+                timings[rule_id] = timings.get(rule_id, 0.0) + \
+                    (perf_counter() - t0)
+
+    findings: list[Finding] = []
+    by_path = {m.relpath: m.pragmas for m in modules}
+    for mod in modules:
+        for rule_id, check in _MODULE_CHECKS.items():
+            findings.extend(f for f in timed(rule_id, check, mod)
+                            if not suppressed(f, mod.pragmas))
+    t0 = perf_counter()
+    project = Project(modules)
+    if timings is not None:
+        timings["model"] = timings.get("model", 0.0) + \
+            (perf_counter() - t0)
+    for rule_id, check in _project_checks().items():
+        findings.extend(
+            f for f in timed(rule_id, check, project)
+            if not suppressed(f, by_path.get(f.path, {})))
+    return findings
 
 
 def analyze_module(src: str, relpath: str = "<string>",
                    path: str = "") -> list[Finding]:
-    """All per-module rules over one source text, suppressions applied."""
+    """Every rule except the docs-dependent knob audit over one source
+    text (a single-module project), suppressions applied."""
     mod = Module(path=path or relpath, relpath=relpath, src=src)
-    findings: list[Finding] = []
-    for check in _MODULE_CHECKS:
-        findings.extend(check(mod))
-    return [f for f in findings if not suppressed(f, mod.pragmas)]
+    return _analyze_modules([mod])
 
 
 def analyze_project(files: list[tuple[str, str]],
                     docs_text: str = "",
                     docs_name: str = "docs/OPERATIONS.md",
-                    rules: set[str] | None = None) -> list[Finding]:
+                    rules: set[str] | None = None,
+                    timings: dict | None = None) -> list[Finding]:
     """Run every rule over ``files`` ([(relpath, source)]), including the
-    cross-file knob audit. Unparseable files yield a single parse-error
-    finding rather than aborting the run."""
+    cross-file knob audit and the interprocedural passes. Unparseable
+    files yield a single parse-error finding rather than aborting the
+    run. ``timings`` (optional dict) is filled with per-rule wall seconds
+    — what the CI job prints against its 30 s budget."""
+    from time import perf_counter
+
     modules: list[Module] = []
     findings: list[Finding] = []
     for relpath, src in files:
@@ -704,11 +742,12 @@ def analyze_project(files: list[tuple[str, str]],
                 rule="RT000", name="parse-error", path=relpath,
                 line=e.lineno or 1, col=(e.offset or 0) + 1,
                 message=f"could not parse: {e.msg}"))
-    for mod in modules:
-        for check in _MODULE_CHECKS:
-            findings.extend(f for f in check(mod)
-                            if not suppressed(f, mod.pragmas))
+    findings.extend(_analyze_modules(modules, timings=timings))
+    t0 = perf_counter()
     knob_findings = check_undocumented_knobs(modules, docs_text, docs_name)
+    if timings is not None:
+        timings["RT007"] = timings.get("RT007", 0.0) + \
+            (perf_counter() - t0)
     by_path = {m.relpath: m.pragmas for m in modules}
     findings.extend(f for f in knob_findings
                     if not suppressed(f, by_path.get(f.path, {})))

@@ -9,12 +9,25 @@ afterwards is tracked:
   edge that closes a cycle (A taken under B somewhere, B taken under A
   elsewhere) is a potential deadlock and is reported ONCE per edge with
   both creation sites and both acquisition stacks.
-* **lock-across-device-boundary** — ``jax.device_put`` / compiled-program
-  dispatch can block for seconds on a busy or flapping interconnect;
-  holding any sanitized lock across that boundary stalls every thread
-  queued on it (the ingest writer blocking REST reads is the motivating
-  shape). The sanitizer patches ``jax.device_put`` when jax is importable
-  and reports a held-lock set at each crossing.
+* **lock-across-device-boundary** — ``jax.device_put`` / ``device_get`` /
+  ``jax.block_until_ready`` / compiled-program dispatch can block for
+  seconds on a busy or flapping interconnect; holding any sanitized lock
+  across those boundaries stalls every thread queued on it (the ingest
+  writer blocking REST reads is the motivating shape). The sanitizer
+  patches all three module-level entry points when jax is importable and
+  reports a held-lock set at each crossing. (The ``.block_until_ready()``
+  METHOD on arrays is a C type slot and cannot be patched — the static
+  RT009 rule covers that spelling at lint time.)
+* **shared-state-race** — an Eraser-style lockset detector over
+  REGISTERED shared structures (the job table, the fold cache, the
+  kernel registry, the transfer stats). Each structure's candidate
+  lockset starts as the lockset of the first post-single-threaded
+  access and is intersected with the locks held at every later access;
+  the moment a second thread is involved, a write under an EMPTY
+  candidate set is a data race and is reported once per structure, keyed
+  by the registration (creation) site. Single-threaded init stays
+  lock-free legitimately: refinement only starts when a second thread
+  shows up, exactly like the original Eraser state machine.
 
 Findings go three ways: a ``logging`` warning, an in-process list
 (``findings()``, what tests assert on), and an ``obs.trace`` instant so
@@ -29,6 +42,7 @@ from __future__ import annotations
 
 import logging
 import os
+import sys
 import threading
 import traceback
 
@@ -41,11 +55,18 @@ _RAW_RLOCK = threading.RLock
 
 def _creation_site() -> str:
     """file:line of the frame that called Lock()/RLock(), skipping this
-    module's own frames."""
-    for frame in reversed(traceback.extract_stack(limit=8)[:-2]):
-        if not frame.filename.endswith("sanitizer.py"):
-            return f"{os.path.basename(frame.filename)}:{frame.lineno}"
-    return "<unknown>"
+    module's own frames. Raw frame walk, NOT traceback.extract_stack:
+    extract_stack touches linecache (file I/O) and costs ~1 ms — and the
+    thread pools create a Condition (= a tracked lock) per Future, so a
+    parallel fold paid that millisecond hundreds of times per sweep
+    (measured: the bulk of a 52%% sanitizer overhead; the frame walk
+    brings lock creation back to microseconds)."""
+    f = sys._getframe(1)
+    while f is not None and f.f_code.co_filename.endswith("sanitizer.py"):
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
 
 
 class _TrackedLock:
@@ -104,8 +125,41 @@ class _TrackedLock:
         return f"<TrackedLock {self.site} over {self._raw!r}>"
 
 
+class SharedTracker:
+    """One registered shared structure for the Eraser-style lockset race
+    detector. Instrumented code calls :meth:`read`/:meth:`write` at its
+    access sites (inside whatever lock it holds); the sanitizer walks the
+    Eraser state machine:
+
+    ``virgin`` → ``exclusive(t1)`` on first access → ``shared`` (second
+    thread reads) / ``shared_modified`` (write with ≥2 threads involved).
+    The candidate lockset is initialised when the second thread arrives
+    and intersected on every later access; an empty candidate set in
+    ``shared_modified`` is a race, reported ONCE per tracker, keyed by
+    the registration (creation) site.
+    """
+
+    __slots__ = ("san", "name", "site", "state", "owner", "lockset",
+                 "reported")
+
+    def __init__(self, san: "LockSanitizer", name: str):
+        self.san = san
+        self.name = name
+        self.site = _creation_site()
+        self.state = "virgin"
+        self.owner = None          # thread ident while exclusive
+        self.lockset: frozenset | None = None   # candidate set
+        self.reported = False
+
+    def read(self) -> None:
+        self.san._shared_access(self, write=False)
+
+    def write(self) -> None:
+        self.san._shared_access(self, write=True)
+
+
 class LockSanitizer:
-    """Lock-ordering graph + device-boundary watcher.
+    """Lock-ordering graph + device-boundary watcher + lockset races.
 
     One instance is installed process-wide via :func:`install`; tests build
     private instances and call :meth:`install`/:meth:`uninstall` directly.
@@ -116,30 +170,50 @@ class LockSanitizer:
         # would recurse into its own sanitizer
         self._mu = _RAW_LOCK()
         self._local = threading.local()
+        # race detection needs a thread token that is NEVER reused:
+        # get_ident() recycles a joined thread's id, which can leave the
+        # Eraser machine stuck in `exclusive` when writer B inherits
+        # writer A's ident (observed as a flaky missed race)
+        import itertools
+
+        self._tid_counter = itertools.count(1)
         #: site → set of sites acquired while this one was held
         self._edges: dict[str, set] = {}
         #: (from, to) edges already reported (report each hazard once)
         self._reported: set = set()
         self._findings: list[dict] = []
+        self._shared: list[SharedTracker] = []
         self._installed = False
         self._jax_patched = False
+        self._raw_jax: dict = {}
         self._tracer = tracer
 
     # ---- install / uninstall ----
 
     def install(self, patch_jax: bool = True) -> "LockSanitizer":
         """Swap the ``threading`` factories for tracking wrappers. Locks
-        created BEFORE install stay untracked (import early)."""
+        created BEFORE install stay untracked (import early). Install is
+        NESTING-AWARE: the previous factories are captured and restored
+        by :meth:`uninstall` — a test's private sanitizer installed on
+        top of the process-wide ``RTPU_SANITIZE`` one must hand the
+        factories BACK to it, not to the raw C implementations (restoring
+        raw mid-suite left every later-created lock untracked, which the
+        race detector then read as lock-free access)."""
         if self._installed:
             return self
         self._installed = True
+        self._prev_lock = prev_lock = threading.Lock
+        self._prev_rlock = prev_rlock = threading.RLock
         san = self
 
+        # wrap the PREVIOUS factory, not the raw one: under a nested
+        # install the inner tracked lock keeps reporting to the outer
+        # sanitizer too, so the process-wide one never loses coverage
         def make_lock():
-            return _TrackedLock(san, _RAW_LOCK(), reentrant=False)
+            return _TrackedLock(san, prev_lock(), reentrant=False)
 
         def make_rlock():
-            return _TrackedLock(san, _RAW_RLOCK(), reentrant=True)
+            return _TrackedLock(san, prev_rlock(), reentrant=True)
 
         threading.Lock = make_lock
         threading.RLock = make_rlock
@@ -151,10 +225,16 @@ class LockSanitizer:
     def uninstall(self) -> None:
         if not self._installed:
             return
-        threading.Lock = _RAW_LOCK
-        threading.RLock = _RAW_RLOCK
+        threading.Lock = getattr(self, "_prev_lock", _RAW_LOCK)
+        threading.RLock = getattr(self, "_prev_rlock", _RAW_RLOCK)
         self._unpatch_jax()
         self._installed = False
+
+    #: module-level jax entry points that can block on the interconnect —
+    #: each gets the same held-locks check (the array METHOD
+    #: ``.block_until_ready()`` is a C slot; rtpulint RT009 covers that
+    #: spelling statically)
+    _JAX_BOUNDARIES = ("device_put", "device_get", "block_until_ready")
 
     def _patch_jax(self) -> None:
         try:
@@ -162,21 +242,27 @@ class LockSanitizer:
         except Exception:
             return   # stripped environment: lock-order checking still works
         san = self
-        raw_put = jax.device_put
+        self._raw_jax = {}
+        for name in self._JAX_BOUNDARIES:
+            raw = getattr(jax, name, None)
+            if raw is None:
+                continue
 
-        def checked_device_put(*args, **kwargs):
-            san.check_boundary("device_put")
-            return raw_put(*args, **kwargs)
+            def checked(*args, __raw=raw, __name=name, **kwargs):
+                san.check_boundary(__name)
+                return __raw(*args, **kwargs)
 
-        self._raw_device_put = raw_put
-        jax.device_put = checked_device_put
+            self._raw_jax[name] = raw
+            setattr(jax, name, checked)
         self._jax_patched = True
 
     def _unpatch_jax(self) -> None:
         if self._jax_patched:
             import jax
 
-            jax.device_put = self._raw_device_put
+            for name, raw in self._raw_jax.items():
+                setattr(jax, name, raw)
+            self._raw_jax = {}
             self._jax_patched = False
 
     # ---- per-thread held stack ----
@@ -282,6 +368,69 @@ class LockSanitizer:
                    "lock(s) %s held across %s — a slow interconnect stalls "
                    "every thread queued on them", held, boundary)
 
+    # ---- lockset race detector (Eraser) ----
+
+    def register_shared(self, name: str) -> SharedTracker:
+        """Register one shared structure for lockset race detection.
+        Call at construction time (the creation site keys the reports);
+        instrument access sites with ``tracker.read()``/``.write()``."""
+        tracker = SharedTracker(self, name)
+        with self._mu:
+            self._shared.append(tracker)
+        return tracker
+
+    def shared_trackers(self) -> list[SharedTracker]:
+        with self._mu:
+            return list(self._shared)
+
+    def _tid(self) -> int:
+        """Per-thread token, unique for the sanitizer's lifetime (next()
+        on a count is atomic under the GIL)."""
+        tid = getattr(self._local, "tid", None)
+        if tid is None:
+            tid = self._local.tid = next(self._tid_counter)
+        return tid
+
+    def _shared_access(self, t: SharedTracker, write: bool) -> None:
+        me = self._tid()
+        held = self._held()
+        locks = frozenset(id(h) for h in held)
+        sites = sorted(h.site for h in held)
+        report = False
+        with self._mu:
+            if t.state == "virgin":
+                t.state, t.owner = "exclusive", me
+            elif t.state == "exclusive":
+                if t.owner == me:
+                    pass   # still single-threaded: init stays lock-free
+                else:
+                    # second thread: refinement starts HERE
+                    t.state = "shared_modified" if write else "shared"
+                    t.lockset = locks
+            else:
+                t.lockset = locks if t.lockset is None \
+                    else (t.lockset & locks)
+                if write:
+                    t.state = "shared_modified"
+            if t.state == "shared_modified" and not t.lockset and \
+                    not t.reported:
+                t.reported = True
+                report = True
+        if report:
+            finding = {
+                "kind": "shared-state-race",
+                "name": t.name,
+                "site": t.site,
+                "access": "write" if write else "read",
+                "held": sites,
+                "thread": threading.current_thread().name,
+                "stack": "".join(traceback.format_stack(limit=12)[:-3]),
+            }
+            self._emit(finding,
+                       "shared structure %r (registered at %s) accessed "
+                       "from multiple threads with an empty common "
+                       "lockset — data race", t.name, t.site)
+
     # ---- reporting ----
 
     def _emit(self, finding: dict, msg: str, *fmt) -> None:
@@ -297,6 +446,9 @@ class LockSanitizer:
             self._tracer = tracer
         if tracer:
             attrs = {k: v for k, v in finding.items() if k != "stack"}
+            # "name" would collide with Tracer.instant's own first param
+            if "name" in attrs:
+                attrs["shared_name"] = attrs.pop("name")
             attrs["sites"] = ",".join(
                 finding.get("sites") or finding.get("held") or [])
             tracer.instant("sanitizer." + finding["kind"], **attrs)
@@ -313,6 +465,9 @@ class LockSanitizer:
             self._findings.clear()
             self._reported.clear()
             self._edges.clear()
+            for t in self._shared:   # re-arm the race detector too
+                t.state, t.owner = "virgin", None
+                t.lockset, t.reported = None, False
 
 
 #: the process-wide instance, set by install()
@@ -337,6 +492,27 @@ def uninstall() -> None:
 
 def active() -> LockSanitizer | None:
     return _ACTIVE
+
+
+def note_shared(tracker: SharedTracker | None, write: bool = False) -> None:
+    """One-line access hook for instrumented structures: no-op on the
+    None tracker the unsanitized path carries (a single falsy check —
+    the zero-overhead-when-unset contract, shared by every registered
+    structure instead of re-implemented per class)."""
+    if tracker is not None:
+        (tracker.write if write else tracker.read)()
+
+
+def track_shared(name: str) -> SharedTracker | None:
+    """Register ``name`` with the ACTIVE sanitizer, or None when no
+    sanitizer is installed — the instrumentation contract: call sites
+    keep a tracker attribute and guard every ``read()``/``write()`` with
+    ``if tracker is not None``, so the unsanitized cost is one falsy
+    check (the zero-overhead-when-unset claim, asserted in tests)."""
+    san = _ACTIVE
+    if san is None or not san._installed:
+        return None
+    return san.register_shared(name)
 
 
 def maybe_install_from_env() -> LockSanitizer | None:

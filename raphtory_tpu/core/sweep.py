@@ -32,6 +32,8 @@ import threading
 
 import numpy as np
 
+from ..analysis.sanitizer import (note_shared as _san_note,
+                                  track_shared as _san_track)
 from .events import EDGE_ADD, EDGE_DELETE, VERTEX_ADD, VERTEX_DELETE, EventLog
 from .snapshot import (
     INT64_MIN,
@@ -106,6 +108,7 @@ def fold_pool():
 
 
 _PREFETCH_POOL = None
+_PREFETCH_POOL_LOCK = threading.Lock()
 
 
 def _prefetch_pool():
@@ -122,8 +125,13 @@ def _prefetch_pool():
     if _PREFETCH_POOL is None:
         from concurrent.futures import ThreadPoolExecutor
 
-        _PREFETCH_POOL = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="sweep-prefetch")
+        # locked like the sibling pools: two sweeps racing the lazy init
+        # would each get a pool and the single-worker invariant (at most
+        # one fold in flight) would silently become two
+        with _PREFETCH_POOL_LOCK:
+            if _PREFETCH_POOL is None:
+                _PREFETCH_POOL = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="sweep-prefetch")
     return _PREFETCH_POOL
 
 
@@ -713,6 +721,13 @@ class FoldCache:
         self.evictions = 0
         # (fp, config) -> ascending checkpoint times, for nearest lookup
         self._ckpt_times: dict[tuple, list] = {}
+        # lockset-sanitizer registration (None unless RTPU_SANITIZE):
+        # cache accesses report their held lockset, so a future unguarded
+        # fast path shows up as a shared-state-race finding in tier-1
+        self._san_tracker = _san_track("fold_cache")
+
+    def _note_shared(self, write: bool) -> None:
+        _san_note(self._san_tracker, write)
 
     # -- internals (callers hold self._lock) --
 
@@ -748,6 +763,7 @@ class FoldCache:
         """Cached value for ``key`` (LRU-touch) or None — counts a hit or
         a miss either way."""
         with self._lock:
+            self._note_shared(write=True)   # LRU touch mutates order
             ent = self._entries.get(key)
             if ent is None:
                 self.misses += 1
@@ -766,6 +782,7 @@ class FoldCache:
         if nbytes > self.max_bytes:
             return False
         with self._lock:
+            self._note_shared(write=True)
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[1]
@@ -784,6 +801,7 @@ class FoldCache:
             return False
         key = ("ckpt", fp, cp.config, int(cp.t_prev))
         with self._lock:
+            self._note_shared(write=True)
             if key in self._entries:
                 self._entries.move_to_end(key)
                 return True
@@ -806,6 +824,7 @@ class FoldCache:
         import bisect
 
         with self._lock:
+            self._note_shared(write=True)   # hit path LRU-touches
             times = self._ckpt_times.get((fp, config))
             if not times:
                 self.misses += 1
@@ -829,6 +848,7 @@ class FoldCache:
 
     def stats(self) -> dict:
         with self._lock:
+            self._note_shared(write=False)
             return {"entries": len(self._entries), "bytes": self._bytes,
                     "max_bytes": self.max_bytes, "hits": self.hits,
                     "misses": self.misses, "evictions": self.evictions}

@@ -44,6 +44,10 @@ class IngestionPipeline:
         self._feeds: list[tuple[Source, Parser]] = []
         self.counts: dict[str, int] = {}
         self.errors: dict[str, str] = {}
+        # source threads AND the staged writer all record failures here —
+        # one lock keeps the first-root-cause-wins setdefault honest
+        # (rtpulint RT010: no common lock across those writers otherwise)
+        self._err_lock = threading.Lock()
         # staged mode (queue_max_events > 0): parse and append run in
         # separate threads with a BOUNDED event queue between them — the
         # reference's writer-mailbox shape (SURVEY §4.5: queue depth was
@@ -167,9 +171,11 @@ class IngestionPipeline:
                 # record the ROOT cause BEFORE raising the poison flag: a
                 # source seeing _failed re-raises a generic RuntimeError,
                 # and its setdefault must lose to this one, not win a race
-                self.errors.setdefault(name, (
-                    f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
-                self._failed.add(name)
+                with self._err_lock:
+                    self.errors.setdefault(name, (
+                        f"{type(e).__name__}: {e}\n"
+                        f"{traceback.format_exc()}"))
+                    self._failed.add(name)
 
     def _sink_batch(self, name: str, t, k, s, d, props=None,
                     wm: int | None = None) -> None:
@@ -227,8 +233,9 @@ class IngestionPipeline:
 
             # setdefault: if the staged writer already recorded the root
             # cause, the re-raised poison marker must not mask it
-            self.errors.setdefault(source.name, (
-                f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+            with self._err_lock:
+                self.errors.setdefault(source.name, (
+                    f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
             METRICS.parse_errors.labels(source.name).inc()
         finally:
             # A dead source will never append again — releasing the fence is

@@ -12,11 +12,14 @@ snapshot cache are reused across hops).
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time as _time
 import traceback
 from dataclasses import dataclass
 
+from ..analysis.sanitizer import (note_shared as _san_note,
+                                  track_shared as _san_track)
 from ..core.service import TemporalGraph
 from ..engine import bsp
 from ..engine.program import VertexProgram
@@ -90,6 +93,17 @@ class Job:
         # path, so every sink went through the path jail + in-use check)
         self.sink = None
         self.results: list[dict] = []
+        # live jobs emit forever; an uncapped result list is the classic
+        # serving slow leak (rtpulint RT011). Oldest rows roll off past
+        # the cap — the sink (file) keeps the full history, the REST
+        # surface reports how many rolled off. 0 disables. The trim
+        # SHRINKS the list, so readers must take results_snapshot()
+        # under the same lock (append-only was prefix-safe to iterate;
+        # a shrink mid-serialization is not).
+        self._results_cap = max(
+            0, int(os.environ.get("RTPU_RESULT_ROWS", 10_000)))
+        self._results_mu = threading.Lock()
+        self.results_dropped = 0
         self.status = "pending"
         self.error: str | None = None
         self._kill = threading.Event()
@@ -110,6 +124,13 @@ class Job:
 
     def wait(self, timeout: float | None = None) -> bool:
         return self._done.wait(timeout)
+
+    def results_snapshot(self) -> list[dict]:
+        """Stable copy of the result rows for readers on other threads —
+        the cap trim shrinks the live list, so serializing it directly
+        would race the job thread."""
+        with self._results_mu:
+            return list(self.results)
 
     # ---- execution ----
 
@@ -668,7 +689,12 @@ class Job:
             "steps": int(steps),
             "result": reduced,
         }
-        self.results.append(row)
+        with self._results_mu:
+            self.results.append(row)
+            if self._results_cap and len(self.results) > self._results_cap:
+                drop = len(self.results) - self._results_cap
+                del self.results[:drop]
+                self.results_dropped += drop
         if self.sink is not None:
             self.sink.write(row)
 
@@ -724,6 +750,29 @@ class AnalysisManager:
         self._jobs: dict[str, Job] = {}
         self._counter = itertools.count()
         self._lock = threading.Lock()
+        # finished jobs are retained for /AnalysisResults but evicted
+        # oldest-first past the cap — an always-up job server must not
+        # grow its job table with every request served. 0 disables.
+        self._table_cap = max(
+            0, int(os.environ.get("RTPU_JOB_TABLE_CAP", 4096)))
+        # lockset-sanitizer registration (None unless RTPU_SANITIZE): job
+        # table accesses report their held lockset; an unguarded access
+        # path surfaces as a shared-state-race finding in tier-1
+        self._san_tracker = _san_track("job_table")
+
+    def _note_table(self, write: bool = False) -> None:
+        _san_note(self._san_tracker, write)
+
+    def _evict_done_locked(self) -> None:
+        """Drop oldest FINISHED jobs past the table cap (caller holds
+        ``_lock``). Running jobs are never evicted — the cap bounds
+        retention, not concurrency (admission control is ROADMAP #1)."""
+        if not self._table_cap or len(self._jobs) <= self._table_cap:
+            return
+        excess = len(self._jobs) - self._table_cap
+        for jid in [jid for jid, j in self._jobs.items()
+                    if j._done.is_set()][:excess]:
+            del self._jobs[jid]
 
     def submit(self, program: VertexProgram, query: Query,
                job_id: str | None = None, mesh=None,
@@ -741,6 +790,8 @@ class AnalysisManager:
                       mesh=mesh if mesh is not None else self.mesh,
                       wait_timeout=wait_timeout, explain=explain)
             self._jobs[job_id] = job
+            self._note_table(write=True)
+            self._evict_done_locked()
         sink = None
         try:
             # disk I/O (mkdirs + open) stays OUTSIDE the registry lock;
@@ -772,17 +823,23 @@ class AnalysisManager:
         return job.start()
 
     def get(self, job_id: str) -> Job:
-        job = self._jobs.get(job_id)
+        # under the registry lock like every other table access: a bare
+        # dict read racing submit's insert/evict is exactly the unguarded
+        # shape the lockset sanitizer flags (rtpulint v2)
+        with self._lock:
+            job = self._jobs.get(job_id)
+            self._note_table()
         if job is None:
             raise KeyError(f"unknown job {job_id!r}")
         return job
 
     def results(self, job_id: str) -> list[dict]:
-        return self.get(job_id).results
+        return self.get(job_id).results_snapshot()
 
     def kill(self, job_id: str) -> None:
         self.get(job_id).kill()
 
     def jobs(self) -> dict[str, str]:
         with self._lock:
+            self._note_table()
             return {jid: j.status for jid, j in self._jobs.items()}

@@ -68,5 +68,9 @@ def _ensure_builtins() -> None:
         if _BUILTINS_LOADED:         # @register decorators) must not
             return                   # deadlock against it
         for nm in A.__all__:
+            # bounded by the builtin algorithm list (loaded once behind
+            # _BUILTINS_LOADED); dynamic rawFile programs are instantiated
+            # per request, never registered — the table cannot grow with
+            # traffic.  # rtpulint: disable=unbounded-growth-on-request-path
             _REGISTRY.setdefault(nm, getattr(A, nm))
         _BUILTINS_LOADED = True

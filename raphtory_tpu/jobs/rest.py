@@ -201,8 +201,15 @@ class _Handler(BaseHTTPRequestHandler):
                 job = self.manager.get(qs["jobID"][0])
                 payload = {
                     "jobID": job.id, "status": job.status,
-                    "error": job.error, "results": job.results,
+                    "error": job.error,
+                    # snapshot: the RTPU_RESULT_ROWS trim shrinks the
+                    # live list on the job thread mid-serialization
+                    "results": job.results_snapshot(),
                 }
+                if job.results_dropped:
+                    # oldest rows rolled off the RTPU_RESULT_ROWS cap —
+                    # the sink file (when configured) has the full set
+                    payload["resultsDropped"] = job.results_dropped
                 if job.explain:
                     payload["ledger"] = job.ledger.as_dict()
                 return self._json(200, payload)

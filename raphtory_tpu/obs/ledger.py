@@ -60,6 +60,8 @@ import sys
 import threading
 import time
 
+from ..analysis.sanitizer import (note_shared as _san_note,
+                                  track_shared as _san_track)
 from .trace import TRACER
 
 #: (peak FLOP/s, peak memory bandwidth B/s) operating points per backend —
@@ -207,10 +209,18 @@ class KernelRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._kernels: dict[tuple, dict] = {}
+        # lockset-sanitizer registration (None unless RTPU_SANITIZE):
+        # every registry access reports its held lockset — an unguarded
+        # path shows up as a shared-state-race finding
+        self._san_tracker = _san_track("kernel_registry")
+
+    def _note_shared(self, write: bool) -> None:
+        _san_note(self._san_tracker, write)
 
     def _ensure(self, name: str, sig: tuple) -> dict:
         key = (name, sig)
         with self._lock:
+            self._note_shared(write=True)
             rec = self._kernels.get(key)
             if rec is None:
                 rec = {
@@ -295,11 +305,13 @@ class KernelRegistry:
     def note_dispatch(self, name: str, sig: tuple) -> dict:
         rec = self._ensure(name, sig)
         with self._lock:
+            self._note_shared(write=True)
             rec["dispatches"] += 1
         return rec
 
     def snapshot(self) -> list[dict]:
         with self._lock:
+            self._note_shared(write=False)
             return [dict(r) for r in self._kernels.values()]
 
     @staticmethod

@@ -99,15 +99,24 @@ class PartitionLayout:
     def device_args(self) -> tuple:
         """The layout's device operands, uploaded once (chunked + retried
         like the static edge tables) then resident: ``(b_src, b_dst,
-        valid, slot, u_src, perm)``."""
+        valid, slot, u_src, perm)``. The upload runs OUTSIDE the lock —
+        holding it across ``device_put`` would stall every other
+        dispatch behind a slow interconnect (the sanitizer's
+        lock-across-device-boundary finding); a rare racing duplicate
+        upload just gets dropped by the loser."""
+        with self._lock:
+            dev = self._dev
+        if dev is not None:
+            return dev
+        from ..utils.transfer import device_put_chunked
+
+        dev = tuple(
+            device_put_chunked(a) for a in
+            (self.b_src, self.b_dst, self.valid, self.slot,
+             self.u_src, self.perm))
         with self._lock:
             if self._dev is None:
-                from ..utils.transfer import device_put_chunked
-
-                self._dev = tuple(
-                    device_put_chunked(a) for a in
-                    (self.b_src, self.b_dst, self.valid, self.slot,
-                     self.u_src, self.perm))
+                self._dev = dev
             return self._dev
 
     def remap_positions(self, pos: np.ndarray) -> np.ndarray:

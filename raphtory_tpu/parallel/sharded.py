@@ -36,6 +36,7 @@ managers + point-to-point actor messages + ack counting
 from __future__ import annotations
 
 import functools
+import threading
 from dataclasses import dataclass
 
 import jax
@@ -182,8 +183,18 @@ def _build_halo(idx_g: np.ndarray, n_loc: int, S: int):
 
 
 # build counter — the amortisation witness: range sweeps that re-partition
-# per hop (the round-3 regression class) show up as increments here
+# per hop (the round-3 regression class) show up as increments here.
+# Bumps go through note_partition_build(): concurrent mesh jobs each
+# build partitions on their own job thread, and an unguarded += loses
+# counts exactly when the witness matters (rtpulint RT010)
 PARTITION_BUILDS = 0
+_BUILDS_LOCK = threading.Lock()
+
+
+def note_partition_build() -> None:
+    global PARTITION_BUILDS
+    with _BUILDS_LOCK:
+        PARTITION_BUILDS += 1
 
 
 def partition_view(view: GraphView, n_shards: int,
@@ -198,8 +209,7 @@ def partition_view(view: GraphView, n_shards: int,
         f"vertex shard count {n_shards} must divide the padded vertex count "
         f"{view.n_pad} (pad buckets are powers of two; use a power-of-two "
         f"vertex-axis size)")
-    global PARTITION_BUILDS
-    PARTITION_BUILDS += 1
+    note_partition_build()
     n_loc = view.n_pad // n_shards
     S = n_shards
 

@@ -48,7 +48,7 @@ class ShardedSweep:
                 f"vertex count ({t.n_pad})")
         S = self.S = n_shards
         n_loc = self.n_loc = t.n_pad // n_shards
-        sharded.PARTITION_BUILDS += 1   # the ONE static build of this sweep
+        sharded.note_partition_build()  # the ONE static build of this sweep
 
         # ---- static partition of the global pair table (both directions) --
         def build(owner_of, local_of, global_of):

@@ -47,11 +47,15 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from collections import deque
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
+
+from ..analysis.sanitizer import (note_shared as _san_note,
+                                  track_shared as _san_track)
 
 _log = logging.getLogger(__name__)
 
@@ -135,7 +139,12 @@ def _tracer():
 
 @dataclass
 class TransferStats:
-    """Cumulative pipeline telemetry for one engine (or the shared one)."""
+    """Cumulative pipeline telemetry for one engine (or the shared one).
+
+    Mutation goes through :meth:`bump` under the stats' own lock: the
+    SHARED engine is driven by every concurrent job thread, and unguarded
+    ``+=`` on these counters loses updates under load (the rtpulint v2
+    lockset detector catches exactly this shape at runtime)."""
 
     bytes_shipped: int = 0
     slices: int = 0
@@ -145,16 +154,41 @@ class TransferStats:
     #                              or drain) — the wire stall the pipeline
     #                              exists to hide
     depth_high_water: int = 0
+    _mu: threading.Lock = field(default_factory=threading.Lock,
+                                repr=False, compare=False)
+    #: lockset-sanitizer handle — attached by shared_engine() ONLY (a
+    #: tracker registration is permanent, and device_put_chunked builds
+    #: a throwaway engine per call)
+    _san_tracker: object = field(default=None, repr=False, compare=False)
+
+    def bump(self, **deltas) -> None:
+        """Atomically add ``deltas`` to counters; ``depth_high_water`` is
+        a max, not a sum. Returns nothing — readers use ``as_dict``."""
+        with self._mu:
+            for k, v in deltas.items():
+                if k == "depth_high_water":
+                    if v > self.depth_high_water:
+                        self.depth_high_water = v
+                else:
+                    setattr(self, k, getattr(self, k) + v)
+            self._note_shared_write()
+
+    def _note_shared_write(self) -> None:
+        """Lockset-sanitizer hook (no-op unless RTPU_SANITIZE installed a
+        tracker): every mutation reports under the stats lock, so a
+        future unguarded write path shows up as a race finding."""
+        _san_note(self._san_tracker, write=True)
 
     def as_dict(self) -> dict:
-        return {
-            "bytes_shipped": int(self.bytes_shipped),
-            "slices": int(self.slices),
-            "retries": int(self.retries),
-            "stage_stall_seconds": round(self.stage_seconds, 4),
-            "wire_stall_seconds": round(self.wire_seconds, 4),
-            "inflight_depth_high_water": int(self.depth_high_water),
-        }
+        with self._mu:
+            return {
+                "bytes_shipped": int(self.bytes_shipped),
+                "slices": int(self.slices),
+                "retries": int(self.retries),
+                "stage_stall_seconds": round(self.stage_seconds, 4),
+                "wire_stall_seconds": round(self.wire_seconds, 4),
+                "inflight_depth_high_water": int(self.depth_high_water),
+            }
 
     def delta_since(self, prior: dict) -> dict:
         """Stats accumulated since a ``prior`` ``as_dict()`` snapshot —
@@ -193,8 +227,8 @@ class TransferEngine:
     # ---- slice lifecycle ----
 
     def _record_depth(self, n: int) -> None:
-        if n > self.stats.depth_high_water:
-            self.stats.depth_high_water = n
+        if n > self.stats.depth_high_water:   # racy fast-path read only —
+            self.stats.bump(depth_high_water=n)  # bump re-checks locked
             m = _metrics()
             if m is not None:
                 m.h2d_inflight_depth.set(n)
@@ -206,7 +240,7 @@ class TransferEngine:
         with _tracer().span("ship.stage", bytes=int(a.nbytes)):
             staged = np.ascontiguousarray(a)
         dt = time.perf_counter() - t0
-        self.stats.stage_seconds += dt
+        self.stats.bump(stage_seconds=dt)
         m = _metrics()
         if m is not None:
             m.h2d_stall_seconds.labels(stage="stage").inc(dt)
@@ -217,8 +251,7 @@ class TransferEngine:
         back to the blocking retry loop for this slice only."""
         import jax
 
-        self.stats.slices += 1
-        self.stats.bytes_shipped += staged.nbytes
+        self.stats.bump(slices=1, bytes_shipped=staged.nbytes)
         m = _metrics()
         if m is not None:
             m.h2d_bytes.inc(staged.nbytes)
@@ -242,7 +275,7 @@ class TransferEngine:
                 "device_put of %.1f MB failed (%s); retry %d/%d in %.0fs",
                 staged.nbytes / 2**20, err, attempt, self.retries - 1, wait)
             time.sleep(wait)
-            self.stats.retries += 1
+            self.stats.bump(retries=1)
             m = _metrics()
             if m is not None:
                 m.h2d_retries.inc()
@@ -272,7 +305,7 @@ class TransferEngine:
                         raise
                     x = self._retry(staged, e)
         dt = time.perf_counter() - t0
-        self.stats.wire_seconds += dt
+        self.stats.bump(wire_seconds=dt)
         m = _metrics()
         if m is not None:
             m.h2d_stall_seconds.labels(stage="wire").inc(dt)
@@ -343,14 +376,27 @@ class TransferEngine:
 
 
 _SHARED: TransferEngine | None = None
+_SHARED_LOCK = threading.Lock()
 
 
 def shared_engine() -> TransferEngine:
     """Process-wide engine (env-configured depth) used by the sweep
-    engines' payload ships — one stats bundle for the whole process."""
+    engines' payload ships — one stats bundle for the whole process.
+    Creation is locked: two REST threads racing the lazy init would
+    otherwise each get an engine and split the process stats between
+    them (rtpulint RT010)."""
     global _SHARED
     if _SHARED is None:
-        _SHARED = TransferEngine()
+        with _SHARED_LOCK:
+            if _SHARED is None:
+                eng = TransferEngine()
+                # lockset-sanitizer registration (None unless
+                # RTPU_SANITIZE): the SHARED engine's stats are driven by
+                # every job thread, so each mutation reports its held
+                # lockset. Only here — a registration is permanent, and
+                # device_put_chunked builds a throwaway engine per call.
+                eng.stats._san_tracker = _san_track("transfer_stats")
+                _SHARED = eng
     return _SHARED
 
 

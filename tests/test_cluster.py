@@ -166,7 +166,6 @@ def test_scheduler_cancel_during_long_tick_sticks():
 def test_node_runtime_staged_ingestion_setting():
     """ingest_queue_events>0 routes node ingestion through the staged
     queue (backlog gauge path) and drains fully."""
-    import numpy as np
 
     from raphtory_tpu.cluster.runtime import NodeRuntime
     from raphtory_tpu.ingestion.source import IterableSource
@@ -187,7 +186,6 @@ def test_node_runtime_staged_ingestion_setting():
 
 
 def test_prewarm_pins_resident_sweep():
-    import numpy as np
 
     from raphtory_tpu.cluster.runtime import NodeRuntime
     from raphtory_tpu.ingestion.source import IterableSource

@@ -7,12 +7,7 @@ import pytest
 
 from raphtory_tpu.core import events as ev
 from raphtory_tpu.core.snapshot import build_view
-from raphtory_tpu.ingestion.router import (
-    Shard,
-    ShardDownError,
-    ShardRouter,
-    merge_logs,
-)
+from raphtory_tpu.ingestion.router import ShardDownError, ShardRouter, merge_logs
 
 
 def _batches(n_batches=20, per=64, seed=0):

@@ -97,7 +97,6 @@ def test_watermark_disorder_bound():
 
 
 def test_live_threaded_ingestion_with_fence():
-    import itertools
     import threading
 
     gate = threading.Event()

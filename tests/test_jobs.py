@@ -217,7 +217,6 @@ def test_single_device_range_uses_device_sweep_and_matches():
 
     from raphtory_tpu.algorithms import ConnectedComponents
     from raphtory_tpu.core.service import TemporalGraph
-    from raphtory_tpu.core.snapshot import build_view
     from raphtory_tpu.engine import bsp
     from raphtory_tpu.jobs.manager import AnalysisManager, RangeQuery
 

@@ -1,7 +1,6 @@
 """Checkpoint round-trip + compaction semantics (compress/archive)."""
 
 import numpy as np
-import pytest
 
 from raphtory_tpu import EventLog, build_view
 from raphtory_tpu.core.service import TemporalGraph

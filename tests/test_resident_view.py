@@ -2,7 +2,6 @@
 DeviceSweep (delta-advance + one dispatch) and agree with the cold path
 (ref: ReaderWorker.scala:293-352 builds a lens per job — the bar)."""
 
-import numpy as np
 import pytest
 
 from raphtory_tpu.jobs import manager as mgr_mod

@@ -3,7 +3,6 @@ properties — and the permutation-invariance (commutativity) invariant the
 reference states (`README.md:6`: updates can arrive out of order)."""
 
 import numpy as np
-import pytest
 
 from raphtory_tpu.core.events import (
     EDGE_ADD,

@@ -12,12 +12,7 @@ import numpy as np
 import pytest
 
 from raphtory_tpu.utils import transfer
-from raphtory_tpu.utils.transfer import (
-    TransferEngine,
-    _is_transient,
-    _put_retry,
-    device_put_chunked,
-)
+from raphtory_tpu.utils.transfer import TransferEngine, _is_transient, _put_retry
 
 from test_sweep import random_log
 
