@@ -77,7 +77,7 @@ def _serve(args) -> int:
         threading.Thread(
             target=lambda: (rt.pipeline.join(),
                             _ingest_summary(aborted=stop.is_set)),
-            daemon=True).start()
+            name="ingest-summary", daemon=True).start()
         signal.signal(signal.SIGINT, lambda *a: stop.set())
         signal.signal(signal.SIGTERM, lambda *a: stop.set())
         stop.wait()

@@ -175,6 +175,14 @@ def prefetch_map(fold_fns, body, *, depth: int | None = None,
     depth = max(1, depth)
     if pool is None:
         pool = _prefetch_pool()
+    # trace-context handoff: each fold task adopts the SUBMITTING
+    # thread's context (the sweep span of the request being served), so
+    # one request's spans stay one trace across the pool boundary even
+    # when concurrent requests share these workers (obs/trace.py). A
+    # no-op (fns unwrapped) when tracing is off or nothing is open.
+    tr = _tracer()
+    if tr is not None:
+        fns = [tr.carry(fn) for fn in fns]
     inflight = collections.deque(
         pool.submit(fns[i]) for i in range(min(depth, len(fns))))
     nxt = len(inflight)
@@ -479,7 +487,12 @@ class SweepBuilder:
             self.v_seen[uvd0] = True
             return uvd0
 
-        v_fut = _vfold_pool().submit(_vertex_fold)
+        # the inner vertex fold crosses to the vfold pool mid-advance:
+        # carry the chunk fold's trace context with it (a no-op wrap
+        # when tracing is off)
+        tr = _tracer()
+        v_fut = _vfold_pool().submit(
+            tr.carry(_vertex_fold) if tr is not None else _vertex_fold)
 
         # -- edge delta marks: own add/delete events --
         enc_ea = self._pack(ds_ea, dd_ea)

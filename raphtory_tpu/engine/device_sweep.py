@@ -36,6 +36,7 @@ such programs fall back to the ``bsp`` path, see ``supported()``).
 from __future__ import annotations
 
 import functools
+import threading as _threading
 import time as _time
 import weakref
 
@@ -696,9 +697,15 @@ class DeviceSweep:
             def task():
                 f0 = _time.perf_counter()
                 payloads = []
+                # worker attr: see hopbatch._fold_groups_parallel — the
+                # span rides the request trace via the pool-handoff
+                # context; the attr names the worker without a metadata
+                # join
                 with TRACER.span("hop.fold", hops=len(segs[i]),
                                     engine="device_sweep",
-                                    mode="parallel"):
+                                    mode="parallel",
+                                    worker=_threading.current_thread(
+                                        ).name):
                     sw = self.sw.fork()
                     prev = sw.t_prev
                     if boundary is not None and (prev is None

@@ -26,6 +26,7 @@ reference runs its full actor handshake once per hop
 from __future__ import annotations
 
 import functools
+import threading as _threading
 import time as _time
 
 import jax
@@ -1311,9 +1312,16 @@ class _HopBatched:
 
             def task():
                 t0 = _time.perf_counter()
+                # worker attr: the pool thread's name on the span itself,
+                # so /tracez?trace_id= shows WHICH fold worker ran each
+                # unit without joining against thread metadata (the span
+                # still joins the request's trace via the pool-handoff
+                # context adopted by prefetch_map — core/sweep.py)
                 with TRACER.span("hop.fold", hops=len(unit["hops"]),
                                     engine=type(self).__name__,
-                                    mode="parallel"):
+                                    mode="parallel",
+                                    worker=_threading.current_thread(
+                                        ).name):
                     sw = self._seed_fork(boundary, cache, fp, cfg)
                     if delta:
                         ship = unit["c"] == 0 and unit["off"] == 0 \

@@ -24,6 +24,7 @@ from ..core.service import TemporalGraph
 from ..engine import bsp
 from ..engine.program import VertexProgram
 from ..obs import ledger as _ledger
+from ..obs import slo as _slo
 from ..obs.metrics import METRICS
 from ..obs.trace import TRACER, block_steps as _block_steps
 
@@ -88,6 +89,15 @@ class Job:
         self.explain = bool(explain)
         self.ledger = _ledger.Ledger(
             job_id, getattr(program, "cost_label", type(program).__name__))
+        # trace-context handoff: a Job is constructed on the SUBMITTING
+        # thread (the REST handler's rest.request span is still open),
+        # and the job thread adopts this context in _run — so one REST
+        # request and its job share one trace id end to end. None when
+        # tracing is off or nothing is open (adopt degrades to a no-op).
+        self._trace_ctx = TRACER.capture()
+        #: trace id of this job's `job` span once it runs (None untraced)
+        #: — the SLO exemplar and the /AnalysisResults correlation key
+        self.trace_id: str | None = None
         self._submitted = _time.perf_counter()
         # ResultSink | None — attached by AnalysisManager.submit (the only
         # path, so every sink went through the path jail + in-use check)
@@ -141,17 +151,27 @@ class Job:
         # real queueing here, and the ledger field is where it shows up)
         self.ledger.queue_wait_seconds = max(
             0.0, _time.perf_counter() - self._submitted)
-        with TRACER.span("job", job_id=self.id,
-                         kind=type(self.query).__name__,
-                         program=type(self.program).__name__) as jsp, \
-                _ledger.activate(self.ledger):
-            self._run_query()
-            jsp.set(status=self.status)
-        # wall is submit → done, so it CONTAINS the queue wait and
-        # finish()'s residual (wall - queue_wait - phases) is exactly the
-        # unattributed run time — the queue_wait + Σphases == wall
-        # invariant holds even once real admission queueing exists
-        self._publish_ledger(_time.perf_counter() - self._submitted)
+        try:
+            with TRACER.adopt(self._trace_ctx), \
+                    TRACER.span("job", job_id=self.id,
+                                kind=type(self.query).__name__,
+                                program=type(self.program).__name__) as jsp, \
+                    _ledger.activate(self.ledger):
+                self.trace_id = jsp.trace or None
+                self.ledger.trace_id = self.trace_id or ""
+                self._run_query()
+                jsp.set(status=self.status)
+            # wall is submit → done, so it CONTAINS the queue wait and
+            # finish()'s residual (wall - queue_wait - phases) is exactly
+            # the unattributed run time — the queue_wait + Σphases ==
+            # wall invariant holds even once real admission queueing
+            # exists
+            self._publish_ledger(_time.perf_counter() - self._submitted)
+        finally:
+            # _done fires LAST: a waiter woken by wait() must observe the
+            # published SLO/exemplar/queue-wait/ledger state — publishing
+            # after the wakeup raced every /slz-after-wait reader
+            self._done.set()
 
     def _publish_ledger(self, wall_seconds: float) -> None:
         """Close the job's ledger and fan it out: per-algorithm
@@ -163,9 +183,29 @@ class Job:
         engine-side hooks."""
         led = self.ledger
         led.finish(wall_seconds, status=self.status)
+        # SLO surface (obs/slo.py): end-to-end latency + per-phase
+        # seconds into the exemplar histograms, keyed by this job's
+        # trace id so a p99 bucket resolves to an actual trace. Fed from
+        # the JOBS-layer timings, which RTPU_LEDGER=0 still collects —
+        # the SLO histograms have their own knob (RTPU_SLO), because the
+        # serving SLO must survive turning cost accounting off. The
+        # queue-wait distribution ships alongside (measured since PR 6,
+        # never exported as a histogram until now).
+        alg = led.algorithm or "unknown"
+        if self.status == "done":
+            # only SUCCESSFUL jobs land in the latency SLI: a burst of
+            # fast failures would otherwise IMPROVE p99 while the service
+            # errors, and a minutes-late kill would inflate the tail for
+            # healthy traffic. Error/kill RATES live in
+            # jobs_completed_total{status}; their latency is not an SLO.
+            _slo.SLO.observe(alg, "e2e", led.wall_seconds,
+                             trace_id=self.trace_id)
+            for ph, sec in dict(led.phase_seconds).items():
+                _slo.SLO.observe(alg, ph, sec, trace_id=self.trace_id)
+        # queue wait is an ADMISSION signal, valid whatever the outcome
+        METRICS.job_queue_wait_seconds.observe(led.queue_wait_seconds)
         if not _ledger.collection_enabled():
             return
-        alg = led.algorithm or "unknown"
         METRICS.query_cost_queries.labels(alg, led.bound()).inc()
         METRICS.query_cost_seconds.labels(alg, "queue_wait").observe(
             led.queue_wait_seconds)
@@ -218,7 +258,8 @@ class Job:
             if self.sink is not None:
                 self.sink.close()   # flush partial output on kill/failure too
             METRICS.jobs_completed.labels(self.status).inc()
-            self._done.set()
+            # _done is set by _run AFTER _publish_ledger — wait()
+            # returning guarantees the telemetry has landed
 
     def _run_live(self, q: LiveQuery) -> None:
         runs = 0

@@ -11,13 +11,24 @@ repeatTime, rawFile) with `params` as an extension for hyperparameters.
 Operational extensions (no reference analogue — SURVEY §5.1 "No spans"):
 GET ``/healthz`` (liveness), ``/statusz`` (job table, watermarks, transfer
 stats, compile-cache sizes, flight-recorder + ledger state), ``/tracez``
-(recent spans; ``?n=``, ``?format=chrome`` for a full Chrome trace-event
+(recent spans; ``?n=``, ``?trace_id=`` for ONE request's spans across
+every thread it touched, ``?format=chrome`` for a full Chrome trace-event
 document, ``?dump=1`` to write it to a server-side temp file,
-``?enable=0|1`` to toggle tracing at runtime), and ``/costz`` (the cost
+``?enable=0|1`` to toggle tracing at runtime), ``/costz`` (the cost
 ledger: per-kernel XLA cost/memory analysis with roofline classification
-plus recent per-query ledgers — docs/OBSERVABILITY.md "Cost ledger").
-POST bodies additionally accept ``explain`` (truthy): the job's resource
-ledger rides back with ``/AnalysisResults``.
+plus recent per-query ledgers — docs/OBSERVABILITY.md "Cost ledger"),
+``/slz`` (per-algorithm SLO latency histograms whose tail buckets carry
+trace-ID exemplars, plus the bounded queue-depth/stall series ring with
+text sparklines — obs/slo.py), and ``/profilez`` (the continuous
+sampling profiler: JSON status, ``?format=collapsed`` flamegraph lines,
+``?enable=0|1`` — obs/sampler.py). POST bodies additionally accept
+``explain`` (truthy): the job's resource ledger rides back with
+``/AnalysisResults``.
+
+Every POST runs under a ``rest.request`` span: the span's trace context
+is captured at submit and adopted by the job thread (obs/trace.py), so
+``/tracez?trace_id=`` reconstructs REST → job → fold workers → transfer
+as ONE trace.
 """
 
 from __future__ import annotations
@@ -28,11 +39,30 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..obs import ledger as _ledger
+from ..obs import slo as _slo
+from ..obs.sampler import SAMPLER
 from ..obs.trace import TRACER
 from . import registry
 from .manager import AnalysisManager, LiveQuery, RangeQuery, ViewQuery
 
 DEFAULT_PORT = 8081
+
+
+class _BadParam(ValueError):
+    """A malformed CLIENT-supplied query parameter — the only
+    ValueError do_GET maps to 400. Internal ValueErrors from payload
+    construction stay 500: reclassifying them would hide genuine server
+    bugs from exactly the 5xx alerting they should trip."""
+
+
+def _num_param(qs: dict, key: str, default, cast):
+    vals = qs.get(key)
+    if not vals:
+        return default
+    try:
+        return cast(vals[0])
+    except ValueError:
+        raise _BadParam(f"{key}={vals[0]!r} is not a number") from None
 
 
 def _compile_cache_sizes() -> dict:
@@ -130,7 +160,30 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _text(self, code: int, text: str) -> None:
+        data = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    @staticmethod
+    def _name_thread() -> None:
+        """ThreadingHTTPServer spawns one anonymous ``Thread-N`` per
+        request — rename it so traces and profiles read as REST work
+        (the tracer refreshes a recycled ident's name on next span)."""
+        t = threading.current_thread()
+        if t.name.startswith("Thread-"):
+            t.name = f"rest-req-{t.ident}"
+
     def do_POST(self):
+        self._name_thread()
+        with TRACER.span("rest.request", method="POST",
+                         path=self.path) as rsp:
+            self._post(rsp)
+
+    def _post(self, rsp):
         try:
             n = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(n) or b"{}")
@@ -165,6 +218,7 @@ class _Handler(BaseHTTPRequestHandler):
                 sink_name=body.get("sinkName"),
                 sink_format=body.get("sinkFormat"),
                 explain=explain)
+            rsp.set(job_id=job.id)
             payload = {"jobID": job.id, "status": job.status}
             if job.sink is not None:
                 payload["sinkPath"] = job.sink.path
@@ -187,12 +241,32 @@ class _Handler(BaseHTTPRequestHandler):
             payload["dumped"] = TRACER.dump()
         if qs.get("format", [""])[0] == "chrome":
             payload["trace"] = TRACER.chrome_trace()
+        elif qs.get("trace_id"):
+            # one request's spans across every thread it touched — what
+            # an /slz exemplar's trace_id resolves to
+            tid = qs["trace_id"][0]
+            payload["trace_id"] = tid
+            payload["spans"] = TRACER.for_trace(tid)
         else:
-            n = int(qs.get("n", ["200"])[0])
-            payload["spans"] = TRACER.recent(n)
+            payload["spans"] = TRACER.recent(_num_param(qs, "n", 200, int))
         self._json(200, payload)
 
+    def _profilez(self, qs: dict) -> None:
+        """Continuous sampling profiler surface (obs/sampler.py):
+        ``?enable=1`` starts it (``&hz=`` overrides the rate),
+        ``?enable=0`` stops it, ``?format=collapsed`` returns the
+        flamegraph collapsed-stack text."""
+        if "enable" in qs:
+            if qs["enable"][0] not in ("0", "false"):
+                SAMPLER.start(_num_param(qs, "hz", None, float))
+            else:
+                SAMPLER.stop()
+        if qs.get("format", [""])[0] == "collapsed":
+            return self._text(200, SAMPLER.collapsed())
+        self._json(200, SAMPLER.status())
+
     def do_GET(self):
+        self._name_thread()
         try:
             parsed = urllib.parse.urlparse(self.path)
             qs = urllib.parse.parse_qs(parsed.query)
@@ -206,6 +280,9 @@ class _Handler(BaseHTTPRequestHandler):
                     # live list on the job thread mid-serialization
                     "results": job.results_snapshot(),
                 }
+                if job.trace_id:
+                    # the request's trace: /tracez?trace_id=<this>
+                    payload["traceID"] = job.trace_id
                 if job.results_dropped:
                     # oldest rows rolled off the RTPU_RESULT_ROWS cap —
                     # the sink file (when configured) has the full set
@@ -231,9 +308,22 @@ class _Handler(BaseHTTPRequestHandler):
                 # per-kernel harvested XLA cost/memory analysis with the
                 # roofline classification + recent per-query ledgers
                 return self._json(200, _ledger.costz())
+            if path == "/slz":
+                # SLO histograms + trace exemplars + the series ring
+                return self._json(
+                    200, _slo.slz_payload(_num_param(qs, "n", 120, int)))
+            if path == "/profilez":
+                return self._profilez(qs)
             return self._json(404, {"error": f"unknown path {self.path}"})
         except KeyError as e:
             self._json(404, {"error": f"KeyError: {e}"})
+        except _BadParam as e:
+            # malformed numeric query params (?n=abc, ?hz=abc) are the
+            # CLIENT's fault — a 500 here would trip 5xx alerting on the
+            # very observability surface being queried. Only _BadParam:
+            # an internal ValueError from payload construction is a
+            # server bug and must stay 500.
+            self._json(400, {"error": f"ValueError: {e}"})
         except Exception as e:  # noqa: BLE001
             self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
@@ -246,11 +336,21 @@ class RestServer:
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
+        # the /slz series ring samples THIS manager's queue depth and
+        # in-flight jobs (weakly registered — the ring is process-wide)
+        _slo.SERIES.attach_manager(manager)
 
     def start(self) -> "RestServer":
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, name="rest", daemon=True)
         self._thread.start()
+        # a serving process is what the over-time surfaces exist for:
+        # start the series ring, and the profiler when RTPU_SAMPLE_HZ
+        # asks for it. Both process-wide singletons, idempotent — left
+        # running on stop() (another server in this process may depend
+        # on them, and an idle 1 Hz sampler is noise)
+        _slo.SERIES.start()
+        SAMPLER.maybe_start()
         return self
 
     def stop(self) -> None:

@@ -6,8 +6,11 @@ set is prometheus_client metrics updated by the pipeline/job/compaction
 layers, plus a JAX profiler hook for device traces (the capability Kamon's
 AspectJ weaver has no analogue for)."""
 
-from .trace import TRACER, Tracer, span   # stdlib-only — always available
+from .trace import (TRACER, TraceContext, Tracer,   # stdlib-only —
+                    span)                           # always available
 from .ledger import Ledger, REGISTRY, instrument   # stdlib-only (jax lazy)
+from .slo import SERIES, SLO                       # stdlib-only
+from .sampler import SAMPLER                       # stdlib-only
 
 try:
     # metrics + device profiling need prometheus_client / jax, which
@@ -20,5 +23,6 @@ except ImportError:   # pragma: no cover — stripped environment
     device_trace = annotate = None
 
 __all__ = ["METRICS", "Metrics", "MetricsServer", "device_trace",
-           "annotate", "TRACER", "Tracer", "span",
-           "Ledger", "REGISTRY", "instrument"]
+           "annotate", "TRACER", "TraceContext", "Tracer", "span",
+           "Ledger", "REGISTRY", "instrument", "SLO", "SERIES",
+           "SAMPLER"]

@@ -400,6 +400,9 @@ class Ledger:
         self._lock = threading.Lock()
         self.query_id = query_id
         self.algorithm = algorithm
+        #: trace id of the owning request's span tree ("" untraced) —
+        #: set by the jobs layer so /costz ledgers join /tracez traces
+        self.trace_id = ""
         self.created_unix = time.time()
         self.queue_wait_seconds = 0.0
         self.wall_seconds = 0.0
@@ -564,6 +567,7 @@ class Ledger:
         return {
             "query_id": self.query_id,
             "algorithm": self.algorithm,
+            "trace_id": self.trace_id,
             "status": self.status,
             "queue_wait_seconds": round(self.queue_wait_seconds, 6),
             "wall_seconds": round(self.wall_seconds, 6),

@@ -136,6 +136,27 @@ class Metrics:
         # per-query resource ledger (obs/ledger.py): what a query COST,
         # by algorithm — the accounting admission control and the PCPM
         # kernel work size themselves from
+        # SLO surface (obs/slo.py): per-request end-to-end latency by
+        # algorithm and phase, bucketed on the SAME grid as the stdlib
+        # exemplar histograms so a Prometheus p99 and an /slz exemplar
+        # point at the same bucket; plus the queue-wait distribution the
+        # admission-control bench will be judged with (the ledger has
+        # measured queue_wait since PR 6 but only as a per-query scalar)
+        from .slo import slo_buckets as _slo_buckets
+
+        self.request_seconds = Histogram(
+            "raphtory_request_seconds",
+            "Per-request latency by ledger phase (phase=e2e is wall "
+            "submit->done; tail buckets keep trace-ID exemplars at /slz)",
+            ["algorithm", "phase"],
+            buckets=(*_slo_buckets(), float("inf")), registry=r)
+        self.job_queue_wait_seconds = Histogram(
+            "raphtory_job_queue_wait_seconds",
+            "Seconds between job submission and its thread running "
+            "(thread-spawn latency today; real admission queueing when "
+            "the serving scheduler lands)",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,
+                     float("inf")), registry=r)
         self.query_cost_seconds = Histogram(
             "raphtory_query_cost_seconds",
             "Per-query wall seconds by ledger phase (fold/stage/ship/"

@@ -1,0 +1,297 @@
+"""Continuous sampling profiler — always-on, low-overhead host stacks.
+
+"Continuous Profiling: Where Have All the Cycles Gone?" made the case
+that profiles you only collect during incidents are profiles of the
+wrong moment; the discipline is an always-on sampler cheap enough to
+forget about. The host-side analogue here: a daemon thread walks
+``sys._current_frames()`` at ``RTPU_SAMPLE_HZ`` (default off; 25 Hz
+costs roughly 10 ms of interpreter time per second on this repo's
+thread counts), aggregates collapsed call stacks per thread, and tags
+every sample with the sampled thread's **active span and trace id**
+(``Tracer.active_for``) — so a flamegraph bucket answers not just
+"where do cycles go" but "which request was burning them".
+
+Surfaces
+--------
+* ``/profilez`` (jobs/rest.py): JSON status; ``?format=collapsed`` emits
+  the standard collapsed-stack flamegraph format (one
+  ``thread;frame;frame… count`` line per distinct stack — feed it to
+  ``flamegraph.pl`` / speedscope); ``?enable=0|1`` toggles at runtime.
+* The flight-recorder dump: the sampler registers a Chrome-export aux
+  provider, so ``/tracez?dump=1`` and the CI failure artifact carry the
+  profile next to the spans (obs/trace.py ``register_aux``).
+* ``RTPU_SAMPLE_DUMP`` — file path; implies sampling on at import, and
+  the collapsed stacks are written there at interpreter exit.
+
+The sampler is GIL-coarse by construction (``sys._current_frames()``
+reports the frame a thread will resume at, not a true interrupt PC) —
+right for attributing WALL time of Python-level phases, which is what
+the fold/emit/serving paths are.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+import threading
+import time
+
+#: cap on DISTINCT aggregated stacks — a pathological workload (deep
+#: recursion over changing line numbers) must not grow host memory
+#: without bound (rtpulint RT011); overflow increments a drop counter
+MAX_STACKS = 8192
+#: frames kept per stack, innermost dropped first beyond this
+MAX_DEPTH = 64
+#: bounded ring of recent tagged samples (the span/trace join surface)
+RECENT = 256
+
+
+def sample_hz() -> float:
+    try:
+        return max(0.0, float(os.environ.get("RTPU_SAMPLE_HZ", "0")))
+    except ValueError:
+        return 0.0
+
+
+def _tracer():
+    from .trace import TRACER
+
+    return TRACER
+
+
+class SamplingProfiler:
+    """Aggregating ``sys._current_frames()`` sampler.
+
+    ``start()``/``stop()`` are idempotent and thread-safe (the REST
+    toggle and the env autostart may race); the sampling thread never
+    takes the aggregation lock while sleeping (rtpulint RT009) and all
+    aggregation state is bounded."""
+
+    def __init__(self, hz: float | None = None):
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        # per-GENERATION stop event, replaced on every start: stop() sets
+        # only the generation it swapped out, both under the lock — a
+        # stop racing a concurrent start can never kill the thread that
+        # start just launched (the REST toggle races the env autostart)
+        self._stop = threading.Event()
+        self.hz = float(hz) if hz is not None else (sample_hz() or 25.0)
+        # aggregation state (all guarded by _lock)
+        self._stacks: dict[tuple, int] = {}   # (thread, frames…) → count
+        self._by_trace: dict[str, int] = {}   # trace_id → samples
+        from collections import deque
+
+        self._recent: deque = deque(maxlen=RECENT)
+        self.samples = 0          # per-thread samples aggregated
+        self.ticks = 0            # sampler wakeups
+        self.dropped_stacks = 0   # distinct-stack cap overflows
+        self.evicted_traces = 0   # oldest per-trace rows evicted at cap
+        self.busy_seconds = 0.0   # interpreter time spent sampling
+
+    # ---- lifecycle ----
+
+    def start(self, hz: float | None = None) -> bool:
+        """Start sampling (idempotent — already-running returns False).
+        ``hz`` overrides the rate, and applies even when already running
+        (the loop re-reads it each tick) — ``/profilez?enable=1&hz=``
+        must retune a live sampler, not silently no-op. ``hz <= 0`` and
+        non-finite rates are refused outright: a running loop divides by
+        ``hz`` each tick, and inf/nan turn the interval into a 0/nan
+        wait — a busy-spin, not a sampler."""
+        with self._lock:
+            if hz is not None:
+                hz = float(hz)
+                if hz <= 0 or not math.isfinite(hz):
+                    return False
+                self.hz = hz
+            if self._thread is not None and self._thread.is_alive():
+                return False
+            if self.hz <= 0 or not math.isfinite(self.hz):
+                return False
+            self._stop = stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._loop, args=(stop,),
+                name="profile-sampler", daemon=True)
+            self._thread.start()
+            return True
+
+    def stop(self) -> bool:
+        """Stop sampling (idempotent — not-running returns False). The
+        aggregated profile is kept; ``clear()`` resets it."""
+        with self._lock:
+            t, self._thread = self._thread, None
+            self._stop.set()   # this generation's event, under the lock
+        if t is None or not t.is_alive():
+            return False
+        t.join(timeout=5.0)
+        return True
+
+    def maybe_start(self) -> bool:
+        """Env-gated start: a no-op unless ``RTPU_SAMPLE_HZ`` > 0 (or a
+        dump path implies sampling) — what servers call at startup."""
+        hz = sample_hz()
+        if hz <= 0 and not os.environ.get("RTPU_SAMPLE_DUMP"):
+            return False
+        return self.start(hz or None)
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._stacks.clear()
+            self._by_trace.clear()
+            self._recent.clear()
+            self.samples = self.ticks = self.dropped_stacks = 0
+            self.evicted_traces = 0
+            self.busy_seconds = 0.0
+
+    # ---- sampling ----
+
+    @staticmethod
+    def _frames_of(frame) -> tuple:
+        """Root-first collapsed frames for one thread's current stack.
+        The full stack is walked and truncation drops the INNERMOST
+        frames — flamegraph tools merge stacks at a common root, and a
+        deep stack clipped at the outer end would fragment into
+        unrelated towers starting mid-stack."""
+        out = []
+        while frame is not None:
+            code = frame.f_code
+            out.append(f"{code.co_name} "
+                       f"({os.path.basename(code.co_filename)}"
+                       f":{frame.f_lineno})")
+            frame = frame.f_back
+        out.reverse()
+        return tuple(out[:MAX_DEPTH])
+
+    def sample_once(self) -> int:
+        """One sampling tick over every live thread except the sampler
+        itself; returns the number of threads sampled."""
+        t0 = time.perf_counter()
+        own = threading.get_ident()
+        try:
+            frames = sys._current_frames()
+        except Exception:   # platform without the CPython API
+            return 0
+        names = {t.ident: t.name for t in threading.enumerate()}
+        tracer = _tracer()
+        n = 0
+        rows = []
+        for tid, frame in frames.items():
+            if tid == own:
+                continue
+            stack = self._frames_of(frame)
+            if not stack:
+                continue
+            active = tracer.active_for(tid)
+            rows.append((names.get(tid, f"tid-{tid}"), stack, active))
+            n += 1
+        now = time.time()
+        with self._lock:
+            for tname, stack, active in rows:
+                key = (tname,) + stack
+                if key in self._stacks:
+                    self._stacks[key] += 1
+                elif len(self._stacks) < MAX_STACKS:
+                    self._stacks[key] = 1
+                else:
+                    self.dropped_stacks += 1
+                if active is not None:
+                    trace_id, sid, span = active
+                    if (trace_id not in self._by_trace
+                            and len(self._by_trace) >= MAX_STACKS):
+                        # evict the OLDEST-inserted trace rather than
+                        # refusing new ones: a long-lived server churns
+                        # through trace ids, and only recent traces are
+                        # still resolvable in the flight-recorder ring
+                        # anyway — saturating on day-one traffic would
+                        # silently freeze the per-trace attribution
+                        self._by_trace.pop(next(iter(self._by_trace)))
+                        self.evicted_traces += 1
+                    self._by_trace[trace_id] = \
+                        self._by_trace.get(trace_id, 0) + 1
+                    self._recent.append({
+                        "unix": round(now, 3), "thread": tname,
+                        "trace_id": trace_id, "span": span,
+                        "leaf": stack[-1],
+                    })
+                self.samples += 1
+            self.ticks += 1
+            self.busy_seconds += time.perf_counter() - t0
+        return n
+
+    def _loop(self, stop: threading.Event) -> None:
+        while True:
+            t0 = time.perf_counter()
+            self.sample_once()
+            spent = time.perf_counter() - t0
+            # sleep OUTSIDE any lock; rate self-corrects for sample cost
+            if stop.wait(max(0.0, 1.0 / self.hz - spent)):
+                return
+
+    # ---- export ----
+
+    def collapsed(self) -> str:
+        """Flamegraph collapsed-stack format: one
+        ``thread;frame;frame… count`` line per distinct stack, heaviest
+        first."""
+        with self._lock:
+            items = sorted(self._stacks.items(),
+                           key=lambda kv: -kv[1])
+        return "\n".join(f"{';'.join(key)} {count}"
+                         for key, count in items)
+
+    def status(self) -> dict:
+        with self._lock:
+            by_trace = dict(sorted(self._by_trace.items(),
+                                   key=lambda kv: -kv[1])[:32])
+            recent = list(self._recent)[-32:]
+            return {
+                "running": self.running,
+                "hz": self.hz,
+                "ticks": self.ticks,
+                "samples": self.samples,
+                "distinct_stacks": len(self._stacks),
+                "dropped_stacks": self.dropped_stacks,
+                "evicted_traces": self.evicted_traces,
+                "busy_seconds": round(self.busy_seconds, 4),
+                "samples_by_trace": by_trace,
+                "recent_tagged": recent,
+            }
+
+    def _aux_block(self):
+        """Chrome-export aux payload (None while nothing was sampled) —
+        folds the profile into the flight-recorder dump."""
+        if not self.ticks:
+            return None
+        st = self.status()
+        st.pop("recent_tagged", None)
+        with self._lock:
+            top = sorted(self._stacks.items(), key=lambda kv: -kv[1])[:64]
+        st["top_stacks"] = [{"stack": list(k), "count": c} for k, c in top]
+        return st
+
+
+SAMPLER = SamplingProfiler()
+_tracer().register_aux("profiler", SAMPLER._aux_block)
+
+_sample_dump = os.environ.get("RTPU_SAMPLE_DUMP")
+if _sample_dump or sample_hz() > 0:
+    SAMPLER.maybe_start()
+if _sample_dump:
+    import atexit
+
+    def _dump_collapsed(path=_sample_dump):
+        try:
+            text = SAMPLER.collapsed()
+            if text:
+                with open(path, "w") as f:
+                    f.write(text + "\n")
+        except Exception:
+            pass
+
+    atexit.register(_dump_collapsed)

@@ -16,6 +16,19 @@ transfer layer can use it in stripped environments):
   nest per thread (a thread-local stack links parent ids), and each span
   optionally enters a ``jax.profiler.TraceAnnotation`` of the same name,
   so host phases line up with XLA ops in an xprof capture.
+* **Trace contexts** — every root span allocates a ``trace_id``, and
+  children inherit it. One REQUEST crosses threads (REST handler → job
+  thread → fold-pool workers → transfer staging), so the per-thread
+  nesting alone would shatter it into unlinked fragments; the explicit
+  handoff API stitches them: ``capture()`` the context on the submitting
+  thread, ``adopt(ctx)`` (or wrap the callable with ``carry(fn)``) on
+  the receiving one. Spans opened under an adoption parent to the
+  captured span and share its trace_id — ``for_trace(trace_id)`` (the
+  REST ``/tracez?trace_id=`` surface) then reconstructs the request
+  end-to-end, and the Chrome export draws cross-thread flow arrows
+  between a span and its other-thread parent. This is the Canopy model
+  of per-request trace assembly (one trace id, events from many
+  execution units, joined after the fact).
 * **Flight recorder** — a bounded ring (``collections.deque(maxlen=…)``)
   of COMPLETED spans. Always cheap: when tracing is off, ``span()``
   returns a shared no-op and records nothing; when on, a span costs two
@@ -57,6 +70,10 @@ class _NullSpan:
 
     __slots__ = ()
 
+    #: NULL_SPAN.trace is None — callers that record "the trace id of the
+    #: span I just ran under" (jobs/manager) read it without a getattr
+    trace = None
+
     def __enter__(self):
         return self
 
@@ -68,6 +85,78 @@ class _NullSpan:
 
 
 NULL_SPAN = _NullSpan()
+
+
+class TraceContext:
+    """A (trace_id, span_id) pair captured on one thread and adopted on
+    another — the request identity that crosses every pool handoff.
+    Immutable value object; build via ``Tracer.capture()``."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self):
+        return f"TraceContext({self.trace_id!r}, {self.span_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, TraceContext)
+                and other.trace_id == self.trace_id
+                and other.span_id == self.span_id)
+
+    def __hash__(self):
+        # defining __eq__ alone would set __hash__ = None — a "value
+        # object" that can't key a set/dict is a trap for callers
+        # deduplicating captured contexts
+        return hash((self.trace_id, self.span_id))
+
+
+class _Adoption:
+    """Context manager returned by ``Tracer.adopt``: installs ``ctx`` as
+    the thread's ambient trace context and restores the previous one on
+    exit — exception-safe (restore happens in ``__exit__`` regardless),
+    and re-entrant (adoptions nest, each restoring its own prior)."""
+
+    __slots__ = ("_tracer", "_ctx", "_prev", "_prev_active", "_tid")
+
+    def __init__(self, tracer: "Tracer", ctx: "TraceContext | None"):
+        self._tracer = tracer
+        self._ctx = ctx
+        self._prev = None
+        self._prev_active = None
+        self._tid = 0
+
+    def __enter__(self):
+        if self._ctx is None:
+            return self
+        tr = self._tracer
+        local = tr._local
+        self._prev = getattr(local, "adopted", None)
+        local.adopted = self._ctx
+        # expose the adopted context to the sampling profiler even while
+        # no span is open on this thread (the sample between two spans of
+        # one request still belongs to that request)
+        t = threading.current_thread()
+        self._tid = t.ident or 0
+        if not tr._stack():
+            self._prev_active = tr._active.get(self._tid)
+            tr._active[self._tid] = (self._ctx.trace_id, self._ctx.span_id,
+                                     "(adopted)")
+        return self
+
+    def __exit__(self, *exc):
+        if self._ctx is None:
+            return False
+        tr = self._tracer
+        tr._local.adopted = self._prev
+        if not tr._stack():
+            if self._prev_active is not None:
+                tr._active[self._tid] = self._prev_active
+            else:
+                tr._active.pop(self._tid, None)
+        return False
 
 #: lazily-resolved jax.profiler.TraceAnnotation (False = unavailable) —
 #: jax must never be a hard dependency of this module
@@ -90,8 +179,8 @@ class Span:
     """One in-flight span. Enter/exit on the SAME thread (the per-thread
     parent stack assumes it); attributes are plain JSON-able values."""
 
-    __slots__ = ("name", "attrs", "sid", "parent", "_tracer", "_tid",
-                 "_t0", "_ann")
+    __slots__ = ("name", "attrs", "sid", "parent", "trace", "_tracer",
+                 "_tid", "_t0", "_ann")
 
     def __init__(self, tracer: "Tracer", name: str, attrs: dict):
         self.name = name
@@ -99,6 +188,7 @@ class Span:
         self._tracer = tracer
         self.sid = next(tracer._ids)
         self.parent = 0
+        self.trace = ""
         self._tid = 0
         self._t0 = 0
         self._ann = None
@@ -111,11 +201,29 @@ class Span:
         tr = self._tracer
         t = threading.current_thread()
         self._tid = t.ident or 0
-        if self._tid not in tr._threads:
+        if tr._threads.get(self._tid) != t.name:
+            # not just first-seen: thread idents are RECYCLED by the OS,
+            # and pools rename threads — a stale entry would label this
+            # thread's track with a dead thread's name in every export
             tr._note_thread(self._tid, t.name)
         stack = tr._stack()
-        self.parent = stack[-1].sid if stack else 0
+        if stack:
+            top = stack[-1]
+            self.parent = top.sid
+            self.trace = top.trace
+        else:
+            ctx = getattr(tr._local, "adopted", None)
+            if ctx is not None:
+                # a pool handoff: parent to the captured span on the
+                # submitting thread, join its trace
+                self.parent = ctx.span_id
+                self.trace = ctx.trace_id
+            else:
+                self.trace = tr._new_trace_id()
         stack.append(self)
+        # cross-thread registry for the sampling profiler: plain dict
+        # store (GIL-atomic), pruned with the thread-name map
+        tr._active[self._tid] = (self.trace, self.sid, self.name)
         cls = _annotation_cls() if tr.annotate else False
         if cls:
             try:
@@ -139,6 +247,16 @@ class Span:
             stack.pop()
         elif self in stack:   # mismatched exits must not corrupt nesting
             stack.remove(self)
+        if stack:
+            top = stack[-1]
+            tr._active[self._tid] = (top.trace, top.sid, top.name)
+        else:
+            ctx = getattr(tr._local, "adopted", None)
+            if ctx is not None:
+                tr._active[self._tid] = (ctx.trace_id, ctx.span_id,
+                                         "(adopted)")
+            else:
+                tr._active.pop(self._tid, None)
         if et is not None:
             self.attrs["error"] = f"{et.__name__}: {ev}"
         tr._record({
@@ -147,6 +265,7 @@ class Span:
             "dur": dur_ns / 1e3,
             "pid": tr._pid, "tid": self._tid,
             "sid": self.sid, "parent": self.parent,
+            "trace": self.trace,
             "args": self.attrs,
         })
         return False
@@ -182,6 +301,18 @@ class Tracer:
         self._epoch_unix = time.time()
         self._pid = os.getpid()
         self._threads: dict[int, str] = {}
+        # tid → (trace_id, span_id, span_name) of the innermost open span
+        # (or adopted context) per thread — the cross-thread read surface
+        # the sampling profiler tags its samples from. Plain dict with
+        # GIL-atomic per-key stores; pruned alongside _threads.
+        self._active: dict[int, tuple] = {}
+        # trace ids: process-unique prefix + counter — cheap (no urandom
+        # per request) yet collision-free across processes in one capture
+        self._trace_prefix = f"{os.getpid():x}-{os.urandom(3).hex()}"
+        self._trace_ids = itertools.count(1)
+        # extra dump payloads (the sampling profiler registers one):
+        # name → zero-arg callable returning a JSON-able block or None
+        self._aux: dict[str, object] = {}
         self._dump_dir: str | None = None   # lazy private dir for dump()
 
     # ---- recording ----
@@ -192,19 +323,27 @@ class Tracer:
             st = self._local.stack = []
         return st
 
+    def _new_trace_id(self) -> str:
+        return f"{self._trace_prefix}-{next(self._trace_ids):x}"
+
     def _prune_threads(self, referenced: set | None = None) -> None:
         """Drop name entries for threads the ring no longer references
         (dead job threads) — called from exports, and from registration
         once the map outgrows the ring it annotates. The ring and the
         name map are snapshotted via atomic C-level copies before
         iterating: concurrent span exits keep appending, and iterating
-        the live deque/dict would raise mid-export."""
+        the live deque/dict would raise mid-export. The active-span
+        registry prunes on the same trigger (a dead thread can no longer
+        be sampled, so its entry is pure leak)."""
         if referenced is None:
             referenced = {e["tid"] for e in list(self._ring)}
         live = {t.ident for t in threading.enumerate()}
         self._threads = {tid: name
                          for tid, name in dict(self._threads).items()
                          if tid in referenced or tid in live}
+        for tid in list(self._active):
+            if tid not in live:
+                self._active.pop(tid, None)
 
     def _note_thread(self, tid: int, name: str) -> None:
         self._threads[tid] = name
@@ -226,18 +365,31 @@ class Tracer:
             return NULL_SPAN
         return Span(self, name, attrs)
 
+    def _ambient(self) -> tuple:
+        """(trace_id, parent span id) of the calling thread's innermost
+        open span, falling back to its adopted context — what instants
+        and completes tag themselves with ("" / 0 when neither)."""
+        st = self._stack()
+        if st:
+            return st[-1].trace, st[-1].sid
+        ctx = getattr(self._local, "adopted", None)
+        if ctx is not None:
+            return ctx.trace_id, ctx.span_id
+        return "", 0
+
     def instant(self, name: str, **attrs) -> None:
         """Zero-duration marker (watermark advances, state flips)."""
         if not self.enabled:
             return
         t = threading.current_thread()
         tid = t.ident or 0
-        if tid not in self._threads:
+        if self._threads.get(tid) != t.name:
             self._note_thread(tid, t.name)
+        trace, _ = self._ambient()
         self._record({
             "ph": "i", "s": "t", "name": name,
             "ts": (time.perf_counter_ns() - self._epoch_ns) / 1e3,
-            "pid": self._pid, "tid": tid, "args": attrs,
+            "pid": self._pid, "tid": tid, "trace": trace, "args": attrs,
         })
 
     def complete(self, name: str, dur_s: float, **attrs) -> None:
@@ -247,17 +399,61 @@ class Tracer:
             return
         t = threading.current_thread()
         tid = t.ident or 0
-        if tid not in self._threads:
+        if self._threads.get(tid) != t.name:
             self._note_thread(tid, t.name)
         now = time.perf_counter_ns()
         dur_ns = max(0.0, float(dur_s)) * 1e9
+        trace, parent = self._ambient()
         self._record({
             "ph": "X", "name": name,
             "ts": (now - dur_ns - self._epoch_ns) / 1e3,
             "dur": dur_ns / 1e3,
             "pid": self._pid, "tid": tid, "sid": next(self._ids),
-            "parent": 0, "args": attrs,
+            "parent": parent, "trace": trace, "args": attrs,
         })
+
+    # ---- cross-thread trace context ----
+
+    def capture(self) -> TraceContext | None:
+        """The calling thread's trace context (innermost open span, else
+        its adopted context) — hand it to the thread that continues this
+        request. None when tracing is off or nothing is open: adopt(None)
+        and carry() degrade to no-ops, so capture-at-submit is always
+        safe to write unconditionally."""
+        if not self.enabled:
+            return None
+        st = self._stack()
+        if st:
+            return TraceContext(st[-1].trace, st[-1].sid)
+        return getattr(self._local, "adopted", None)
+
+    def adopt(self, ctx: TraceContext | None) -> _Adoption:
+        """Install ``ctx`` as this thread's ambient trace context for the
+        duration of the returned context manager. Spans opened inside
+        (with no other enclosing span) parent to the captured span and
+        share its trace. Exception-safe and re-entrant; ``adopt(None)``
+        is a no-op."""
+        return _Adoption(self, ctx)
+
+    def carry(self, fn):
+        """Wrap a zero-or-more-arg callable so it runs under the CALLING
+        thread's current trace context — the one-line pool handoff:
+        ``pool.submit(tracer.carry(task))``. When tracing is off or no
+        context is open the callable is returned unwrapped (zero cost)."""
+        ctx = self.capture()
+        if ctx is None:
+            return fn
+
+        def run(*a, **kw):
+            with self.adopt(ctx):
+                return fn(*a, **kw)
+        return run
+
+    def active_for(self, tid: int) -> tuple | None:
+        """(trace_id, span_id, span_name) of the innermost open span (or
+        adopted context) on thread ``tid`` — the sampling profiler's tag
+        lookup. None when that thread has nothing open."""
+        return self._active.get(tid)
 
     # ---- lifecycle ----
 
@@ -297,6 +493,46 @@ class Tracer:
         snap = list(self._ring)
         return snap[-n:]
 
+    def for_trace(self, trace_id: str) -> list[dict]:
+        """Every buffered event of one trace, oldest first — the
+        ``/tracez?trace_id=`` request-reconstruction surface (and what an
+        SLO exemplar resolves to). Spans evicted from the bounded ring
+        are gone; the ``recorded``/``dropped`` counters say whether the
+        window still covers the request."""
+        return [e for e in list(self._ring) if e.get("trace") == trace_id]
+
+    def register_aux(self, name: str, fn) -> None:
+        """Attach a zero-arg provider whose return value rides in every
+        Chrome export's ``otherData`` under ``name`` (None = omit) — how
+        the sampling profiler folds its collapsed stacks into the
+        flight-recorder dump without spamming the span ring."""
+        self._aux[str(name)] = fn
+
+    @staticmethod
+    def _flow_events(events: list[dict]) -> list[dict]:
+        """Chrome flow-arrow pairs (ph ``s``/``f``) for every span whose
+        parent completed on ANOTHER thread — the visible cross-thread
+        handoffs (REST → job → fold workers) in Perfetto. Only pairs
+        where both ends are in the snapshot can be drawn; a parent still
+        open at export time simply has no arrow yet."""
+        by_sid = {e["sid"]: e for e in events
+                  if e.get("ph") == "X" and "sid" in e}
+        flows = []
+        for e in events:
+            if e.get("ph") != "X" or not e.get("parent"):
+                continue
+            p = by_sid.get(e["parent"])
+            if p is None or p["tid"] == e["tid"]:
+                continue
+            ts = min(p["ts"], e["ts"])
+            flows.append({"ph": "s", "cat": "handoff", "name": "handoff",
+                          "id": e["sid"], "pid": e["pid"],
+                          "tid": p["tid"], "ts": ts})
+            flows.append({"ph": "f", "bp": "e", "cat": "handoff",
+                          "name": "handoff", "id": e["sid"],
+                          "pid": e["pid"], "tid": e["tid"], "ts": e["ts"]})
+        return flows
+
     def chrome_trace(self) -> dict:
         """Perfetto / chrome://tracing compatible trace-event JSON dict:
         the ring's events plus thread-name metadata (one track per
@@ -312,14 +548,22 @@ class Tracer:
             "args": {"name": name},
         } for tid, name in sorted(dict(self._threads).items())
             if tid in referenced]
+        other = {
+            "epoch_unix": self._epoch_unix,
+            "recorded": self._recorded,
+            "dropped": self.dropped,
+        }
+        for name, fn in dict(self._aux).items():
+            try:
+                block = fn()
+            except Exception:   # an aux provider must never break a dump
+                block = None
+            if block is not None:
+                other[name] = block
         return {
-            "traceEvents": meta + events,
+            "traceEvents": meta + self._flow_events(events) + events,
             "displayTimeUnit": "ms",
-            "otherData": {
-                "epoch_unix": self._epoch_unix,
-                "recorded": self._recorded,
-                "dropped": self.dropped,
-            },
+            "otherData": other,
         }
 
     def dump(self, path: str | None = None) -> str:

@@ -190,6 +190,17 @@ class TransferStats:
                 "inflight_depth_high_water": int(self.depth_high_water),
             }
 
+    def totals(self) -> dict:
+        """Cheap cumulative snapshot for periodic samplers (the /slz
+        series ring diffs consecutive samples into per-interval rates):
+        bytes shipped and combined stage+wire stall seconds."""
+        with self._mu:
+            return {
+                "bytes_shipped": int(self.bytes_shipped),
+                "stall_seconds": round(
+                    self.stage_seconds + self.wire_seconds, 6),
+            }
+
     def delta_since(self, prior: dict) -> dict:
         """Stats accumulated since a ``prior`` ``as_dict()`` snapshot —
         how benches attribute shared-engine traffic to one timed region.

@@ -1,0 +1,294 @@
+"""Continuous sampling profiler (obs/sampler.py) + /profilez surface.
+
+Start/stop idempotency must hold under RTPU_SANITIZE=1 (tier-1 runs the
+whole suite with the lock sanitizer installed, so these tests exercise
+exactly that), samples tag themselves with the sampled thread's active
+span/trace, the collapsed-stack export parses, and the profile folds
+into the flight-recorder dump via the tracer's aux-provider hook.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from raphtory_tpu.obs.sampler import SAMPLER, SamplingProfiler
+from raphtory_tpu.obs.trace import TRACER
+
+
+@pytest.fixture
+def global_trace():
+    was = TRACER.enabled
+    TRACER.enable()
+    try:
+        yield TRACER
+    finally:
+        TRACER.enabled = was
+
+
+@pytest.fixture
+def busy_thread():
+    """A named worker spinning in a recognisable function until told to
+    stop — something for the sampler to catch red-handed."""
+    stop = threading.Event()
+    started = threading.Event()
+
+    def crunch_numbers():
+        started.set()
+        x = 0
+        while not stop.is_set():
+            x += 1
+        return x
+
+    t = threading.Thread(target=crunch_numbers, name="busy-bee",
+                         daemon=True)
+    t.start()
+    started.wait(5)
+    try:
+        yield t
+    finally:
+        stop.set()
+        t.join(5)
+
+
+def test_off_by_default_maybe_start(monkeypatch):
+    monkeypatch.delenv("RTPU_SAMPLE_HZ", raising=False)
+    monkeypatch.delenv("RTPU_SAMPLE_DUMP", raising=False)
+    s = SamplingProfiler()
+    assert s.maybe_start() is False and not s.running
+    monkeypatch.setenv("RTPU_SAMPLE_HZ", "not-a-number")
+    assert s.maybe_start() is False and not s.running
+
+
+def test_start_stop_idempotent_under_sanitizer():
+    # tier-1 sets RTPU_SANITIZE=1 for the whole suite: the lock/Event
+    # churn of repeated lifecycle flips runs under the wrapped factories
+    s = SamplingProfiler(hz=200.0)
+    for _ in range(3):
+        ticks0 = s.status()["ticks"]
+        assert s.start() is True
+        assert s.start() is False      # second start: no second thread
+        assert s.running
+        # each restart's thread LIVES and samples — a stale generation's
+        # stop event must never kill a freshly started thread (stop()
+        # sets only the event it swapped out, under the lock)
+        deadline = time.time() + 5
+        while s.status()["ticks"] == ticks0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert s.status()["ticks"] > ticks0
+        assert s.stop() is True
+        assert s.stop() is False       # second stop: no-op
+        assert not s.running
+    assert s.start(hz=0) is False      # hz<=0 refuses to spin
+    assert not s.running               # ...and did not start
+
+
+def test_start_refuses_non_finite_hz():
+    # /profilez?enable=1&hz=inf parses as a valid float — but 1/inf == 0
+    # turns the tick wait into a busy-spin (and nan poisons it the same
+    # way), so non-finite rates are refused like hz<=0, stopped or live
+    s = SamplingProfiler(hz=25.0)
+    for bad in (float("inf"), float("nan"), float("-inf")):
+        assert s.start(hz=bad) is False
+        assert not s.running
+    assert s.start() is True
+    try:
+        assert s.start(hz=float("inf")) is False
+        assert s.hz == 25.0 and s.running   # refused, rate untouched
+    finally:
+        s.stop()
+    s.hz = float("inf")                     # constructed/poisoned state
+    assert s.start() is False and not s.running
+
+
+def test_start_retunes_hz_while_running():
+    # /profilez?enable=1&hz= on an ALREADY-running sampler (e.g. the
+    # RTPU_SAMPLE_DUMP autostart in CI) must apply the new rate, not
+    # silently no-op; hz<=0 is refused (a live loop would divide by it)
+    s = SamplingProfiler(hz=25.0)
+    assert s.start() is True
+    try:
+        assert s.start(hz=200.0) is False   # already running...
+        assert s.hz == 200.0                # ...but retuned
+        assert s.start(hz=0) is False
+        assert s.hz == 200.0 and s.running  # refused, rate untouched
+    finally:
+        s.stop()
+
+
+def test_deep_stacks_keep_root_frames():
+    from raphtory_tpu.obs import sampler as mod
+
+    s = SamplingProfiler(hz=100.0)
+    done = threading.Event()
+    go = threading.Event()
+
+    def recurse(n):
+        if n:
+            return recurse(n - 1)
+        go.set()
+        done.wait(5)
+
+    t = threading.Thread(target=recurse, args=(mod.MAX_DEPTH + 40,),
+                         name="deep-diver", daemon=True)
+    t.start()
+    go.wait(5)
+    try:
+        s.sample_once()
+    finally:
+        done.set()
+        t.join(5)
+    (stack,) = [k for k in s._stacks if k[0] == "deep-diver"]
+    frames = stack[1:]
+    assert len(frames) == mod.MAX_DEPTH
+    # truncation drops the INNERMOST frames: the thread-root frames stay
+    # so flamegraph tools can merge at a common base
+    assert "_bootstrap" in frames[0]
+    assert any("recurse" in f for f in frames)
+    assert "wait" not in frames[-1]    # the innermost leaf was clipped
+
+
+def test_samples_aggregate_and_collapsed_format(busy_thread):
+    s = SamplingProfiler(hz=250.0)
+    assert s.start() is True
+    time.sleep(0.25)
+    assert s.stop() is True
+    st = s.status()
+    assert st["ticks"] >= 5 and st["samples"] >= st["ticks"]
+    text = s.collapsed()
+    lines = text.splitlines()
+    assert lines
+    for line in lines:   # "thread;frame;frame... count"
+        assert re.fullmatch(r"[^ ].*;.+ \d+", line), line
+    assert any(line.startswith("busy-bee;") for line in lines)
+    assert "crunch_numbers" in text
+    # heaviest-first ordering
+    counts = [int(line.rsplit(" ", 1)[1]) for line in lines]
+    assert counts == sorted(counts, reverse=True)
+    # stopping keeps the aggregate; clear() resets it
+    s.clear()
+    assert s.collapsed() == "" and s.status()["samples"] == 0
+
+
+def test_samples_tagged_with_active_span_trace(global_trace, busy_thread):
+    s = SamplingProfiler(hz=100.0)
+    done = threading.Event()
+    trace_box = {}
+
+    def traced_work():
+        with TRACER.span("busy.loop") as sp:
+            trace_box["trace"] = sp.trace
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 0.15:
+                sum(range(500))
+        done.set()
+
+    t = threading.Thread(target=traced_work, name="traced-worker",
+                         daemon=True)
+    t.start()
+    while not done.is_set():
+        s.sample_once()        # deterministic ticks, no sampler thread
+        time.sleep(0.01)
+    t.join(5)
+    st = s.status()
+    assert trace_box["trace"] in st["samples_by_trace"]
+    tagged = [r for r in st["recent_tagged"]
+              if r["trace_id"] == trace_box["trace"]]
+    assert tagged and tagged[-1]["span"] == "busy.loop"
+    assert tagged[-1]["thread"] == "traced-worker"
+
+
+def test_distinct_stack_cap_counts_drops(busy_thread):
+    from raphtory_tpu.obs import sampler as mod
+
+    s = SamplingProfiler(hz=100.0)
+    # pre-fill to the cap: further NEW stacks must drop, counted
+    for i in range(mod.MAX_STACKS):
+        s._stacks[("synthetic", f"frame-{i}")] = 1
+    s.sample_once()
+    assert s.dropped_stacks > 0
+    assert len(s._stacks) == mod.MAX_STACKS
+
+
+def test_per_trace_table_evicts_oldest_not_newest(global_trace,
+                                                  busy_thread):
+    from raphtory_tpu.obs import sampler as mod
+
+    s = SamplingProfiler(hz=100.0)
+    # a long-lived server churns trace ids past the cap: the table must
+    # keep attributing NEW traces (evicting the oldest), never freeze
+    for i in range(mod.MAX_STACKS):
+        s._by_trace[f"old-{i}"] = 1
+    done = threading.Event()
+
+    def traced_work():
+        with TRACER.span("evict.probe"):
+            done.wait(5)
+
+    t = threading.Thread(target=traced_work, name="evictee", daemon=True)
+    t.start()
+    time.sleep(0.05)
+    try:
+        s.sample_once()
+    finally:
+        done.set()
+        t.join(5)
+    assert s.evicted_traces >= 1
+    assert "old-0" not in s._by_trace          # oldest went
+    assert len(s._by_trace) == mod.MAX_STACKS  # still bounded
+    assert any(k not in (f"old-{i}" for i in range(mod.MAX_STACKS))
+               for k in s._by_trace)           # the new trace landed
+
+
+def test_profile_folds_into_flight_recorder_dump(global_trace, tmp_path,
+                                                 busy_thread):
+    # the GLOBAL sampler is wired as a tracer aux provider at import —
+    # one manual tick is enough for the dump to carry a profile block
+    # (CI may already be running it via RTPU_SAMPLE_DUMP; ticks only add)
+    SAMPLER.sample_once()
+    with TRACER.span("dumped"):
+        pass
+    path = TRACER.dump(str(tmp_path / "flight.json"))
+    doc = json.loads(open(path).read())
+    prof = doc["otherData"]["profiler"]
+    assert prof["ticks"] >= 1
+    assert prof["top_stacks"] and "count" in prof["top_stacks"][0]
+
+
+def test_profilez_rest_surface(global_trace, busy_thread):
+    from raphtory_tpu.jobs.manager import AnalysisManager
+    from raphtory_tpu.jobs.rest import RestServer
+    from raphtory_tpu.core.service import TemporalGraph
+    from raphtory_tpu.ingestion.pipeline import IngestionPipeline
+    from raphtory_tpu.ingestion.source import RandomSource
+
+    was_running = SAMPLER.running
+    pipe = IngestionPipeline()
+    pipe.add_source(RandomSource(500, id_pool=50, seed=61,
+                                 name="prof_rest"))
+    pipe.run()
+    g = TemporalGraph(pipe.log, pipe.watermarks)
+    srv = RestServer(AnalysisManager(g), port=0).start()
+    try:
+        def get(path):
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}{path}", timeout=10).read()
+
+        st = json.loads(get("/profilez?enable=1&hz=200"))
+        assert st["running"] is True and st["hz"] == 200.0
+        time.sleep(0.2)
+        st = json.loads(get("/profilez"))
+        assert st["samples"] > 0
+        text = get("/profilez?format=collapsed").decode()
+        assert "busy-bee;" in text
+        st = json.loads(get("/profilez?enable=0"))
+        assert st["running"] is False
+    finally:
+        srv.stop()
+        if was_running:   # restore the CI env-autostarted sampler
+            SAMPLER.start()
+        else:
+            SAMPLER.stop()

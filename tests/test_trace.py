@@ -4,6 +4,10 @@ Covers the PR-3 acceptance line end to end: span nesting/attributes and
 ring eviction under concurrent writers, Chrome trace-event export schema,
 the live REST endpoints, and an RTPU_TRACE'd range sweep producing the
 job → sweep → hop → {fold, stage, ship, compute} → superstep timeline.
+Plus the request-scoped trace context layer: capture/adopt/carry across
+thread handoffs, trace-id inheritance, cross-thread flow arrows in the
+Chrome export, and the ``for_trace`` reconstruction surface (the /slz
+exemplar workflow's other half lives in tests/test_slo.py).
 """
 
 import json
@@ -12,7 +16,8 @@ import urllib.request
 
 import pytest
 
-from raphtory_tpu.obs.trace import TRACER, NULL_SPAN, Tracer
+from raphtory_tpu.obs.trace import (NULL_SPAN, TRACER, TraceContext,
+                                    Tracer)
 
 
 @pytest.fixture
@@ -255,6 +260,171 @@ def test_watermark_and_ingest_spans(global_trace):
     app = next(e for e in TRACER.recent(10**6)
                if e["name"] == "ingest.append")
     assert app["args"]["source"] == "tr_wm" and app["args"]["events"] > 0
+
+
+def test_root_span_allocates_trace_children_inherit():
+    tr = Tracer(enabled=True, ring=64, annotate=False)
+    with tr.span("root") as root:
+        assert root.trace
+        with tr.span("child") as child:
+            assert child.trace == root.trace
+    with tr.span("other") as other:
+        assert other.trace != root.trace   # a NEW request, a new trace
+    evs = {e["name"]: e for e in tr.recent(10)}
+    assert evs["child"]["trace"] == evs["root"]["trace"]
+    assert evs["other"]["trace"] != evs["root"]["trace"]
+
+
+def test_capture_adopt_links_across_threads():
+    tr = Tracer(enabled=True, ring=64, annotate=False)
+    with tr.span("submit") as root:
+        ctx = tr.capture()
+        assert ctx == TraceContext(root.trace, root.sid)
+
+        def work():
+            with tr.adopt(ctx):
+                with tr.span("worker.task"):
+                    pass
+        t = threading.Thread(target=work, name="pool-w0")
+        t.start()
+        t.join()
+    evs = {e["name"]: e for e in tr.recent(10)}
+    assert evs["worker.task"]["trace"] == evs["submit"]["trace"]
+    assert evs["worker.task"]["parent"] == evs["submit"]["sid"]
+    assert evs["worker.task"]["tid"] != evs["submit"]["tid"]
+
+
+def test_capture_none_when_disabled_or_idle():
+    tr = Tracer(enabled=False, ring=64, annotate=False)
+    assert tr.capture() is None
+    fn = lambda: 1                      # noqa: E731
+    assert tr.carry(fn) is fn           # zero-cost identity when off
+    tr2 = Tracer(enabled=True, ring=64, annotate=False)
+    assert tr2.capture() is None        # nothing open on this thread
+    with tr2.adopt(None):               # adopt(None) is a safe no-op
+        with tr2.span("x") as sp:
+            assert sp.trace             # still allocates its own trace
+    assert NULL_SPAN.trace is None
+    # a hashable value object: contexts deduplicate in sets/dicts
+    a, b = TraceContext("t", 1), TraceContext("t", 1)
+    assert len({a, b}) == 1 and {a: 1}[b] == 1
+
+
+def test_adopt_restores_on_exception_and_nests():
+    tr = Tracer(enabled=True, ring=64, annotate=False)
+    c1 = TraceContext("t-1", 11)
+    c2 = TraceContext("t-2", 22)
+    with pytest.raises(ValueError):
+        with tr.adopt(c1):
+            with tr.adopt(c2):
+                assert tr.capture() == c2
+                raise ValueError("boom")
+    # both adoptions unwound despite the exception
+    assert tr.capture() is None
+    with tr.adopt(c1):
+        with tr.adopt(c2):
+            pass
+        assert tr.capture() == c1       # inner restored the outer
+    assert tr.capture() is None
+
+
+def test_carry_runs_fn_under_submitters_context():
+    tr = Tracer(enabled=True, ring=64, annotate=False)
+    seen = []
+    with tr.span("submit") as root:
+        wrapped = tr.carry(
+            lambda: seen.append(tr.capture() and tr.capture().trace_id))
+    t = threading.Thread(target=wrapped)
+    t.start()
+    t.join()
+    assert seen == [root.trace]
+
+
+def test_instant_and_complete_tagged_with_ambient_trace():
+    tr = Tracer(enabled=True, ring=64, annotate=False)
+    with tr.span("outer") as sp:
+        tr.instant("mark")
+        tr.complete("stall", 0.01)
+    evs = {e["name"]: e for e in tr.recent(10)}
+    assert evs["mark"]["trace"] == sp.trace
+    assert evs["stall"]["trace"] == sp.trace
+    assert evs["stall"]["parent"] == sp.sid
+
+
+def test_for_trace_reconstructs_one_request():
+    tr = Tracer(enabled=True, ring=256, annotate=False)
+    with tr.span("req.a") as a:
+        ctx = tr.capture()
+        t = threading.Thread(
+            target=tr.carry(lambda: tr.span("a.child").__enter__().__exit__(
+                None, None, None)))
+        t.start()
+        t.join()
+    with tr.span("req.b"):
+        pass
+    mine = tr.for_trace(a.trace)
+    assert {e["name"] for e in mine} == {"req.a", "a.child"}
+    assert all(e["trace"] == a.trace for e in mine)
+    assert ctx.trace_id == a.trace
+    assert tr.for_trace("no-such-trace") == []
+
+
+def test_chrome_export_draws_cross_thread_flow_arrows():
+    tr = Tracer(enabled=True, ring=64, annotate=False)
+    with tr.span("submit"):
+        ctx = tr.capture()
+
+        def work():
+            with tr.adopt(ctx), tr.span("hop"):
+                pass
+        t = threading.Thread(target=work)
+        t.start()
+        t.join()
+    doc = json.loads(json.dumps(tr.chrome_trace()))
+    flows = [e for e in doc["traceEvents"] if e.get("cat") == "handoff"]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    s, f = (next(e for e in flows if e["ph"] == p) for p in ("s", "f"))
+    assert s["id"] == f["id"] and s["tid"] != f["tid"]
+    assert s["ts"] <= f["ts"]
+    # same-thread nesting draws NO arrow
+    tr2 = Tracer(enabled=True, ring=64, annotate=False)
+    with tr2.span("a"):
+        with tr2.span("b"):
+            pass
+    doc2 = tr2.chrome_trace()
+    assert not [e for e in doc2["traceEvents"]
+                if e.get("cat") == "handoff"]
+
+
+def test_thread_rename_refreshes_track_metadata():
+    tr = Tracer(enabled=True, ring=64, annotate=False)
+    me = threading.current_thread()
+    old = me.name
+    try:
+        me.name = "before-rename"
+        with tr.span("s1"):
+            pass
+        me.name = "after-rename"   # pool naming / recycled-ident case
+        with tr.span("s2"):
+            pass
+        doc = tr.chrome_trace()
+        rows = [e for e in doc["traceEvents"]
+                if e["ph"] == "M" and e["tid"] == (me.ident or 0)]
+        assert rows and rows[0]["args"]["name"] == "after-rename"
+    finally:
+        me.name = old
+
+
+def test_register_aux_rides_in_other_data():
+    tr = Tracer(enabled=True, ring=64, annotate=False)
+    tr.register_aux("payload", lambda: {"x": 1})
+    tr.register_aux("absent", lambda: None)
+    tr.register_aux("broken", lambda: 1 / 0)
+    with tr.span("s"):
+        pass
+    other = tr.chrome_trace()["otherData"]
+    assert other["payload"] == {"x": 1}
+    assert "absent" not in other and "broken" not in other
 
 
 def test_sweep_phase_histogram_observed(global_trace):
