@@ -1903,6 +1903,74 @@ def bench_pcpm_ab():
     }
 
 
+def bench_multichip_obs_overhead():
+    """Distributed-observability overhead on a REAL 2-process localhost
+    cluster (ISSUE 10 acceptance: <= 5%).
+
+    tools/cluster_smoke.py spawns two jax.distributed processes (CPU
+    backend, 2 local devices each, port-strided REST planes), proves the
+    federation path first (one cross-process trace id, /clusterz shows
+    both members + nonzero collective bytes), then worker 0 runs
+    interleaved telemetry-off/on pairs of a jobs-layer sharded range
+    sweep — off = tracing + SLO + ledger all off, on = all on, the
+    collective spans/metrics of parallel/sharded.py included — with
+    worker 1 alive and serving its REST plane throughout. Judged on the
+    MEDIAN per-pair ratio (the shared-box protocol); the one-shot
+    /clusterz scrape cost rides in the detail, outside the timed window.
+    RTPU_BENCH_CHEAP=1 shrinks the shape for CI
+    (`multichip_obs_overhead_cheap`, its own perfwatch series)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from cluster_smoke import run_cluster
+
+    cheap = os.environ.get("RTPU_BENCH_CHEAP", "0") not in ("", "0")
+    pairs = 9 if cheap else 7
+    res = run_cluster(pairs=pairs, cheap=cheap, timeout_s=900.0)
+    name = ("multichip_obs_overhead_cheap" if cheap
+            else "multichip_obs_overhead")
+    if res["skipped"]:
+        return {"config": name, "metric": "2-process cluster smoke",
+                "value": 0.0, "unit": "error",
+                "error": "jax cannot form a localhost distributed "
+                         "cluster on this backend", "detail": {}}
+    ab = res["pairs"]
+    ratios = sorted(on / off for off, on in ab)
+    median = ratios[len(ratios) // 2] if len(ratios) % 2 \
+        else (ratios[len(ratios) // 2 - 1] + ratios[len(ratios) // 2]) / 2
+    off_min = min(off for off, _ in ab)
+    on_min = min(on for _, on in ab)
+    return {
+        "config": name,
+        "metric": ("distributed-telemetry overhead on a 2-process "
+                   "localhost cluster sharded range sweep (collective "
+                   "spans/metrics + tracing + SLO + ledger on vs all "
+                   "off, " + ("CI cheap shape)" if cheap
+                              else "120k-event shape)")),
+        "value": round((median - 1.0) * 100.0, 2),
+        "unit": "percent_slower_with_telemetry",
+        "detail": {
+            "n_views": res["n_views"],
+            "engine": "jobs_manager_range over a local 2-device mesh "
+                      "per process (jax.distributed 2-process cluster)",
+            "cheap_mode": cheap,
+            "timing": ("interleaved_ABBA_pairs_median_ratio — per-pair "
+                       "off/on ratios with alternating arm order cancel "
+                       "shared-box drift; worker 1 serves its REST "
+                       "plane throughout"),
+            "pairs": [[round(a, 4), round(b, 4)] for a, b in ab],
+            "per_pair_overhead_pct": [round((r - 1) * 100, 2)
+                                      for r in ratios],
+            "min_vs_min_overhead_pct": round(
+                (on_min / off_min - 1.0) * 100.0, 2),
+            "telemetry_off_seconds": round(off_min, 4),
+            "telemetry_on_seconds": round(on_min, 4),
+            "clusterz_scrape_seconds": res["clusterz_scrape_seconds"],
+            "acceptance": "on/off regression must stay <= 5%",
+            "baseline": "the all-off column of this same row",
+        },
+    }
+
+
 CONFIGS = {
     "headline": bench_headline,
     "pcpm_ab": bench_pcpm_ab,
@@ -1915,6 +1983,10 @@ CONFIGS = {
     "transfer_pipeline": bench_transfer_pipeline,
     "trace_overhead": bench_trace_overhead,
     "telemetry_overhead": bench_telemetry_overhead,
+    # 2-process localhost cluster A/B: spawns its own subprocess pair,
+    # excluded from --suite (underscore-free but cluster-shaped) — run
+    # it explicitly: bench.py --config multichip_obs_overhead
+    "multichip_obs_overhead": bench_multichip_obs_overhead,
     "gab_cc_range": bench_gab_cc_range,
     "gab_pr_view": bench_gab_pr_view,
     "bitcoin_range": bench_bitcoin_range,
@@ -2021,7 +2093,8 @@ def main():
         names = [args.config]
     else:
         names = [n for n in CONFIGS
-                 if n != "headline" and not n.startswith("_")] + ["headline"]
+                 if n != "headline" and not n.startswith("_")
+                 and n != "multichip_obs_overhead"] + ["headline"]
 
     device = "uninitialised"
     probe: dict = {}

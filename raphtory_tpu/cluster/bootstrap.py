@@ -11,9 +11,11 @@ inside compiled programs, not a message broker.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import jax
+
+from ..obs.trace import TRACER
 
 
 def bootstrap(coordinator_address: str | None = None,
@@ -26,6 +28,10 @@ def bootstrap(coordinator_address: str | None = None,
     way, ``ConfigUtils.scala:19-34``). Single-process deployments (the
     reference's ``SingleNodeSetup``) skip initialisation entirely: returns
     False when there is nothing to join.
+
+    On success the process tracer learns ``jax.process_index()`` — every
+    captured ``TraceContext`` then carries this process as its origin and
+    every ``superstep``/``comm.*`` span is tagged ``process=``.
     """
     if num_processes is None and coordinator_address is None and \
             "JAX_COORDINATOR_ADDRESS" not in os.environ and \
@@ -36,34 +42,61 @@ def bootstrap(coordinator_address: str | None = None,
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id)
+        TRACER.set_process_index(jax.process_index())
         return True
     except RuntimeError as e:
         if "already initialized" in str(e).lower():
+            TRACER.set_process_index(jax.process_index())
             return True
         raise
 
 
 @dataclass(frozen=True)
 class Topology:
-    """What the mesh builder needs to know about this deployment."""
+    """What the mesh builder needs to know about this deployment — plus
+    where every peer's REST plane listens (``peers``), so ``/clusterz``
+    federation needs no hand-wired peer list on a strided localhost
+    cluster."""
 
     n_devices: int
     n_local_devices: int
     n_processes: int
     process_id: int
     platform: str
+    #: per-process REST base URLs derived from the port-striding scheme
+    #: (index i binds rest_port + i x RTPU_PORT_STRIDE on peer_host) —
+    #: RTPU_CLUSTER_PEERS overrides for non-localhost deployments
+    peers: tuple = field(default=())
 
     @property
     def multi_host(self) -> bool:
         return self.n_processes > 1
 
 
-def topology() -> Topology:
+def peer_urls(n_processes: int, rest_port: int | None = None,
+              host: str | None = None) -> tuple:
+    """The deployment's per-process REST base URLs, in process order.
+
+    ``RTPU_CLUSTER_PEERS`` (comma-separated ``host:port`` / URLs, or
+    ``@/path/to/peers.txt`` one-per-line) wins when set — real multi-host
+    deployments name their peers. Otherwise the bootstrap topology is
+    enough: peer ``i`` listens on ``rest_port + i * RTPU_PORT_STRIDE``
+    (utils/config.strided_port) on ``RTPU_PEER_HOST`` (default
+    127.0.0.1 — the N-process localhost cluster). One definition:
+    ``obs/cluster.resolve_peers`` (stdlib-only; /clusterz shares it)."""
+    from ..obs.cluster import resolve_peers
+
+    return resolve_peers(n_processes, rest_port, host)
+
+
+def topology(rest_port: int | None = None) -> Topology:
     devs = jax.devices()
+    n_proc = jax.process_count()
     return Topology(
         n_devices=len(devs),
         n_local_devices=len(jax.local_devices()),
-        n_processes=jax.process_count(),
+        n_processes=n_proc,
         process_id=jax.process_index(),
         platform=devs[0].platform if devs else "none",
+        peers=peer_urls(n_proc, rest_port),
     )

@@ -32,7 +32,7 @@ class NodeRuntime:
         self.watchdog = watchdog or WatchDog(self.settings)
         self.scheduler = Scheduler()
         self.multi_host = bootstrap() if not self.settings.local else False
-        self.topology = topology()
+        self.topology = topology(self.settings.rest_port)
         # restore-a-dead-shard: a replacement node with the same checkpoint
         # dir rehydrates the log before serving (the reference designed this
         # via Cassandra + SAVING, Utils.scala:22; here persist/checkpoint)
@@ -81,7 +81,8 @@ class NodeRuntime:
         if rest:
             from ..jobs.rest import RestServer
 
-            self._rest = RestServer(self.manager, port=s.rest_port).start()
+            self._rest = RestServer(self.manager, port=s.rest_port,
+                                    watchdog=self.watchdog).start()
         if metrics:
             from ..obs.metrics import MetricsServer
 

@@ -8,6 +8,16 @@ enough of every role is present (66-83); stale members are flagged after
 ``auto_down_after_s`` (the Akka ``auto-down-unreachable-after`` analogue,
 application.conf:152). Elastic growth parity: ids only grow, and observers
 can subscribe to component-count changes (``PartitionsCount`` republish).
+
+Control-plane observability: every membership transition (join, stale,
+auto-down, rejoin-after-down) lands as a flight-recorder instant
+(``cluster.join`` / ``cluster.stale`` / ``cluster.auto_down`` /
+``cluster.rejoin``) and refreshes the ``raphtory_cluster_members{role}``
+and ``raphtory_cluster_stale_members`` gauges — what ``/statusz`` embeds
+per process and ``/clusterz`` federates across the deployment. Instants
+and gauge pushes happen OUTSIDE the registry lock: the telemetry layer
+must never extend this hot mutex's hold time (or deadlock through a
+metrics callback).
 """
 
 from __future__ import annotations
@@ -15,6 +25,8 @@ from __future__ import annotations
 import threading
 import time as _time
 
+from ..obs.metrics import METRICS
+from ..obs.trace import TRACER
 from ..utils.config import Settings
 
 
@@ -29,6 +41,31 @@ class WatchDog:
         self._beats: dict[tuple[str, int], float] = {}
         self._down: set[tuple[str, int]] = set()
         self._watchers: list = []
+        # members already reported stale — each staleness EPISODE emits
+        # one instant, not one per stale() poll
+        self._stale_flagged: set[tuple[str, int]] = set()
+
+    # ---- telemetry (all outside the lock) ----
+
+    def _counts_locked(self) -> tuple[dict[str, int], int]:
+        """(live members per role, stale count) — caller holds _lock."""
+        now = self._clock()
+        bar = self.settings.stale_after_s
+        counts = {r: 0 for r in self.ROLES}
+        stale = 0
+        for (r, c), t in self._beats.items():
+            if (r, c) in self._down:
+                continue
+            counts[r] = counts.get(r, 0) + 1
+            if now - t > bar:
+                stale += 1
+        return counts, stale
+
+    @staticmethod
+    def _push_gauges(counts: dict[str, int], stale: int) -> None:
+        for role, n in counts.items():
+            METRICS.cluster_members.labels(role).set(n)
+        METRICS.cluster_stale.set(stale)
 
     # ---- id assignment (RequestPartitionId → AssignedId) ----
 
@@ -43,6 +80,10 @@ class WatchDog:
             self._beats[(role, cid)] = self._clock()
             watchers = list(self._watchers)
             count = self._next_id[role]
+            counts, stale = self._counts_locked()
+        self._push_gauges(counts, stale)
+        TRACER.instant("cluster.join", role=role, id=cid,
+                       members=counts.get(role, 0))
         for w in watchers:  # PartitionsCount republish analogue
             w(role, count)
         return cid
@@ -58,14 +99,25 @@ class WatchDog:
         """Refresh a member's keep-alive. Beats from ids that never
         ``join``ed are rejected (returns False) — an unknown sender must
         not conjure a live member into the quorum counts."""
+        rejoined = recovered = False
         with self._lock:
             key = (role, cid)
             if key not in self._beats:
                 return False
             if key in self._down:   # a member that beats again rejoins
                 self._down.discard(key)
+                rejoined = True
+            if key in self._stale_flagged:   # staleness episode over
+                self._stale_flagged.discard(key)
+                recovered = True
             self._beats[key] = self._clock()
-            return True
+            if rejoined or recovered:
+                counts, stale = self._counts_locked()
+        if rejoined or recovered:
+            self._push_gauges(counts, stale)
+        if rejoined:
+            TRACER.instant("cluster.rejoin", role=role, id=cid)
+        return True
 
     def members(self, role: str | None = None) -> list[tuple[str, int]]:
         with self._lock:
@@ -76,18 +128,34 @@ class WatchDog:
     # ---- health ----
 
     def stale(self) -> list[tuple[str, int, float]]:
-        """(role, id, seconds-silent) for members past the staleness bar."""
+        """(role, id, seconds-silent) for members past the staleness bar.
+        Newly stale members emit ONE ``cluster.stale`` instant each (the
+        episode ends when the member beats again); every call refreshes
+        the stale-members gauge."""
         now = self._clock()
         bar = self.settings.stale_after_s
+        newly: list[tuple[str, int, float]] = []
         with self._lock:
-            return sorted(
+            out = sorted(
                 (r, c, now - t) for (r, c), t in self._beats.items()
                 if (r, c) not in self._down and now - t > bar)
+            for r, c, silent in out:
+                if (r, c) not in self._stale_flagged:
+                    self._stale_flagged.add((r, c))
+                    newly.append((r, c, silent))
+            counts, stale_n = self._counts_locked()
+        self._push_gauges(counts, stale_n)
+        for r, c, silent in newly:
+            TRACER.instant("cluster.stale", role=r, id=c,
+                           silent_seconds=round(silent, 3))
+        return out
 
     def auto_down(self) -> list[tuple[str, int]]:
         """Mark members silent past ``auto_down_after_s`` as down; returns
         the newly downed set. Down members drop out of cluster_up counts
-        until they beat again."""
+        until they beat again. Each transition emits a
+        ``cluster.auto_down`` instant and drops the member from the
+        ``raphtory_cluster_members`` gauge."""
         now = self._clock()
         bar = self.settings.auto_down_after_s
         newly = []
@@ -95,7 +163,14 @@ class WatchDog:
             for key, t in self._beats.items():
                 if key not in self._down and now - t > bar:
                     self._down.add(key)
+                    self._stale_flagged.discard(key)
                     newly.append(key)
+            if newly:
+                counts, stale_n = self._counts_locked()
+        if newly:
+            self._push_gauges(counts, stale_n)
+            for r, c in sorted(newly):
+                TRACER.instant("cluster.auto_down", role=r, id=c)
         return sorted(newly)
 
     # ---- cluster-up gate (WatchDog.scala:66-83) ----
@@ -117,3 +192,28 @@ class WatchDog:
                 return True
             _time.sleep(poll_s)
         return self.cluster_up()
+
+    # ---- observability snapshot (/statusz, federated by /clusterz) ----
+
+    def status(self) -> dict:
+        """Membership snapshot: live ids per role, stale members with
+        silence, auto-downed members, and the cluster-up verdict."""
+        now = self._clock()
+        bar = self.settings.stale_after_s
+        with self._lock:
+            members = sorted(k for k in self._beats
+                             if k not in self._down)
+            down = sorted(self._down)
+            stale = sorted(
+                [r, c, round(now - t, 3)]
+                for (r, c), t in self._beats.items()
+                if (r, c) not in self._down and now - t > bar)
+        by_role: dict[str, list[int]] = {}
+        for r, c in members:
+            by_role.setdefault(r, []).append(c)
+        return {
+            "cluster_up": self.cluster_up(),
+            "members": by_role,
+            "stale": stale,
+            "down": [[r, c] for r, c in down],
+        }

@@ -15,6 +15,7 @@ T <= safe_time. No acks — applying an event IS its acknowledgement.
 from __future__ import annotations
 
 import threading
+import time as _time
 
 from ..obs.metrics import METRICS
 from ..obs.trace import TRACER
@@ -28,6 +29,13 @@ class WatermarkRegistry:
         self._cond = threading.Condition(self._lock)
         self._marks: dict[str, int] = {}
         self._done: set[str] = set()
+        # freshness clock for raphtory_watermark_lag_seconds: when the
+        # global safe time last MOVED (monotonic). A pull-time gauge —
+        # the newest registry wires the callable, so the serving node's
+        # graph wins over short-lived test registries.
+        self._safe_seen = _NEG_INF
+        self._advanced_at = _time.monotonic()
+        METRICS.watermark_lag.set_function(self.lag_seconds)
 
     def register(self, source: str) -> None:
         with self._lock:
@@ -72,8 +80,23 @@ class WatermarkRegistry:
         # compute-and-set under _lock: a preempted thread must not clobber a
         # newer safe_time with a stale lower one
         t = self._safe_locked()
+        if t > self._safe_seen:   # the fence MOVED — freshness resets
+            self._safe_seen = t
+            self._advanced_at = _time.monotonic()
         if abs(t) < 2**62:  # only meaningful mid-stream values
             METRICS.watermark.set(t)
+
+    def lag_seconds(self) -> float:
+        """Seconds since this process's global safe time last advanced —
+        0 while the fence is moving (or nothing is streaming), growing
+        when a live source stalls. The per-process
+        ``raphtory_watermark_lag_seconds`` gauge reads this at scrape
+        time; /statusz and /clusterz embed it."""
+        with self._lock:
+            live = [s for s in self._marks if s not in self._done]
+            if not live:
+                return 0.0   # no live sources: nothing can be stalled
+            return max(0.0, _time.monotonic() - self._advanced_at)
 
     def safe_time(self) -> int:
         """Largest T such that every live source has promised no more events

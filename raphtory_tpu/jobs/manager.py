@@ -217,6 +217,9 @@ class Job:
         METRICS.query_cost_est_hbm_bytes.labels(alg).inc(
             snap["device"]["est_bytes_accessed"])
         METRICS.query_cost_h2d_bytes.labels(alg).inc(snap["h2d"]["bytes"])
+        if snap["dcn"]["bytes"]:
+            METRICS.query_cost_dcn_bytes.labels(alg).inc(
+                snap["dcn"]["bytes"])
         _ledger.note_completed(led)
 
     def _run_query(self) -> None:

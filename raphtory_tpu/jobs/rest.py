@@ -41,7 +41,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..obs import ledger as _ledger
 from ..obs import slo as _slo
 from ..obs.sampler import SAMPLER
-from ..obs.trace import TRACER
+from ..obs.trace import TRACER, TraceContext
+from ..utils.config import process_index, strided_port
 from . import registry
 from .manager import AnalysisManager, LiveQuery, RangeQuery, ViewQuery
 
@@ -100,7 +101,9 @@ def _fold_cache_status() -> dict:
     return {"enabled": True, **cache.stats()}
 
 
-def _statusz(manager: AnalysisManager) -> dict:
+def _statusz(manager: AnalysisManager,
+             handler: "type[_Handler] | _Handler | None" = None) -> dict:
+    from ..parallel.sharded import COLLECTIVES
     from ..utils.transfer import shared_engine
 
     g = manager.graph
@@ -110,6 +113,7 @@ def _statusz(manager: AnalysisManager) -> dict:
         "log_events": int(g.log.n),
         "watermark": {
             "safe_time": int(g.safe_time()),
+            "lag_seconds": round(g.watermarks.lag_seconds(), 3),
             "sources": {k: int(v)
                         for k, v in g.watermarks.snapshot().items()},
         },
@@ -118,12 +122,37 @@ def _statusz(manager: AnalysisManager) -> dict:
         "fold_cache": _fold_cache_status(),
         "trace": TRACER.status(),
         "ledger": _ledger.status_block(),
+        # the distributed half: which process this is, where its
+        # listeners actually bound (what /clusterz discovery reads), and
+        # what the cross-shard collectives moved
+        "cluster": _cluster_block(handler),
     }
     try:
         status["latest_time"] = int(g.latest_time)
     except Exception:   # empty log has no latest time
         status["latest_time"] = None
+    status["collectives"] = COLLECTIVES.snapshot()
     return status
+
+
+def _cluster_block(handler=None) -> dict:
+    """The ``cluster`` block of /statusz: process identity, ACTUAL bound
+    ports (ephemeral binds resolve here — the ports peers federate on),
+    and watchdog membership when this server fronts a NodeRuntime."""
+    from ..obs import metrics as _metrics
+
+    out: dict = {"process_index": process_index()}
+    ports: dict = {}
+    if handler is not None and getattr(handler, "rest_port", None):
+        ports["rest"] = handler.rest_port
+    mp = _metrics.bound_port()
+    if mp:
+        ports["metrics"] = mp
+    out["ports"] = ports
+    wd = getattr(handler, "watchdog", None) if handler is not None else None
+    if wd is not None:
+        out["watchdog"] = wd.status()
+    return out
 
 
 def _windows_from(body: dict):
@@ -148,6 +177,8 @@ def _program_from(body: dict):
 class _Handler(BaseHTTPRequestHandler):
     manager: AnalysisManager = None  # injected by serve()
     allow_dynamic: bool = True
+    watchdog = None       # NodeRuntime's WatchDog when serving a node
+    rest_port: int = 0    # actual bound port, set by RestServer
 
     def log_message(self, *a):  # quiet
         pass
@@ -179,9 +210,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         self._name_thread()
-        with TRACER.span("rest.request", method="POST",
-                         path=self.path) as rsp:
-            self._post(rsp)
+        # a POST carrying X-RTPU-Trace is a forwarded hop of a request
+        # that started on another process: adopt the wire context so this
+        # process's spans JOIN that trace instead of opening a new one
+        ctx = TraceContext.from_wire(self.headers.get(TraceContext.HEADER))
+        with TRACER.adopt(ctx):
+            with TRACER.span("rest.request", method="POST", path=self.path,
+                             process=TRACER.process_index) as rsp:
+                if ctx is not None:
+                    rsp.set(origin_process=ctx.origin)
+                self._post(rsp)
 
     def _post(self, rsp):
         try:
@@ -220,6 +258,14 @@ class _Handler(BaseHTTPRequestHandler):
                 explain=explain)
             rsp.set(job_id=job.id)
             payload = {"jobID": job.id, "status": job.status}
+            # the submitter (or forwarding peer) learns the trace id
+            # without polling /AnalysisResults — what the 2-process smoke
+            # joins cross-process traces on. The handler span's trace IS
+            # the job's trace (the job thread adopts the context captured
+            # under it); job.trace_id itself only lands once the job
+            # thread starts, which this response must not wait for.
+            if rsp.trace:
+                payload["traceID"] = rsp.trace
             if job.sink is not None:
                 payload["sinkPath"] = job.sink.path
             self._json(200, payload)
@@ -267,6 +313,21 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         self._name_thread()
+        # peer scrapes (/clusterz federation) carry X-RTPU-Trace: adopt
+        # it so the serve side of the scrape lands in the SAME trace as
+        # the scraping process's rest.scrape span. Plain GETs (no
+        # header) keep their zero-span fast path.
+        ctx = TraceContext.from_wire(self.headers.get(TraceContext.HEADER))
+        with TRACER.adopt(ctx):
+            if ctx is not None:
+                with TRACER.span("rest.serve_scrape", path=self.path,
+                                 process=TRACER.process_index,
+                                 origin_process=ctx.origin):
+                    self._get()
+            else:
+                self._get()
+
+    def _get(self):
         try:
             parsed = urllib.parse.urlparse(self.path)
             qs = urllib.parse.parse_qs(parsed.query)
@@ -301,7 +362,15 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/healthz":
                 return self._json(200, {"status": "ok"})
             if path == "/statusz":
-                return self._json(200, _statusz(self.manager))
+                return self._json(200, _statusz(self.manager, self))
+            if path == "/clusterz":
+                from ..obs.cluster import clusterz
+
+                return self._json(200, clusterz(
+                    manager=self.manager, handler=self,
+                    trace_id=(qs.get("trace_id") or [None])[0],
+                    refresh=(qs.get("refresh", ["0"])[0]
+                             not in ("0", "false"))))
             if path == "/tracez":
                 return self._tracez(qs)
             if path == "/costz":
@@ -330,11 +399,22 @@ class _Handler(BaseHTTPRequestHandler):
 
 class RestServer:
     def __init__(self, manager: AnalysisManager, port: int = DEFAULT_PORT,
-                 host: str = "127.0.0.1", allow_dynamic: bool = True):
+                 host: str = "127.0.0.1", allow_dynamic: bool = True,
+                 watchdog=None):
         handler = type("Handler", (_Handler,),
-                       {"manager": manager, "allow_dynamic": allow_dynamic})
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+                       {"manager": manager, "allow_dynamic": allow_dynamic,
+                        "watchdog": watchdog})
+        # stride the listen port by jax.process_index() so an N-process
+        # localhost cluster never collides on :8081 (RTPU_PORT_STRIDE;
+        # port 0 stays ephemeral, process 0 binds the base verbatim)
+        self.httpd = ThreadingHTTPServer((host, strided_port(port)),
+                                         handler)
         self.port = self.httpd.server_address[1]
+        handler.rest_port = self.port   # what /statusz reports to peers
+        # the UNSTRIDED base: what peer-URL derivation needs (peer i is
+        # base + i*stride — deriving from an already-strided port would
+        # double-offset every peer on a non-zero process)
+        handler.rest_base_port = int(port) or None
         self._thread: threading.Thread | None = None
         # the /slz series ring samples THIS manager's queue depth and
         # in-flight jobs (weakly registered — the ring is process-wide)

@@ -1,0 +1,361 @@
+"""/clusterz — federated cluster observability.
+
+Every process of a deployment serves the same single-process surfaces
+(``/statusz``, ``/tracez``, ``/slz``); until now an operator diagnosing a
+2-process straggler had to hand-curl N ports and join the answers in
+their head. ``/clusterz`` does the join server-side: ANY process scrapes
+its peers' ``/statusz`` (and, per trace id, ``/tracez``) and renders one
+merged cluster view — membership, per-process queue depth and watermark
+lag, per-route collective seconds/bytes/rows, per-shard halo/degree skew,
+per-process barrier wait, and cross-process traces reassembled by id.
+
+Design rules (the RT009/RT011 lint territory this module sits in):
+
+* **Scrapes happen outside every lock.** The peer list is resolved and
+  the HTTP fan-out completes before the snapshot cache is touched; the
+  cache lock only ever guards dict ops. A slow peer can cost the caller
+  its bounded timeout, never block another thread on a mutex.
+* **A dead peer is DATA, not an error.** Scrape failures render as
+  ``reachable: false`` with the error string; ``/clusterz`` itself never
+  500s because a member died — that is precisely when it is needed.
+* **Bounded everything.** Peer scrapes carry ``RTPU_CLUSTERZ_TIMEOUT``
+  (default 2 s) socket timeouts; the snapshot cache holds at most
+  ``_CACHE_MAX`` peers (oldest evicted) with a short TTL so a 1 Hz
+  dashboard poll doesn't multiply scrape traffic across the mesh.
+
+Peer discovery: ``RTPU_CLUSTER_PEERS`` (comma-separated ``host:port``
+or URLs, or ``@/path/file`` one-per-line) when set — real multi-host
+deployments name their peers; otherwise the bootstrap topology is enough:
+process ``i`` listens on ``rest_port + i x RTPU_PORT_STRIDE`` (the
+localhost port-striding scheme, utils/config.strided_port).
+
+Every peer scrape carries the caller's ``X-RTPU-Trace`` context, so the
+scrape itself reconstructs as one trace across the processes it touched.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.parse
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+from ..utils.config import process_index, strided_port
+from .trace import TRACER, TraceContext
+
+DEFAULT_TIMEOUT_S = 2.0
+_CACHE_MAX = 64          # bounded peer-snapshot cache (RT011)
+_CACHE_TTL_S = 2.0       # fresh-enough window for repeat polls
+
+#: statuses that occupy the job table (everything not yet terminal)
+_ACTIVE_STATUSES = ("pending", "running")
+
+
+def clusterz_timeout() -> float:
+    """``RTPU_CLUSTERZ_TIMEOUT`` — per-peer scrape socket timeout."""
+    try:
+        return max(0.1, float(
+            os.environ.get("RTPU_CLUSTERZ_TIMEOUT", "") or DEFAULT_TIMEOUT_S))
+    except ValueError:
+        return DEFAULT_TIMEOUT_S
+
+
+# ------------------------------------------------------------ discovery
+
+
+def _static_peer_spec() -> tuple[str, str | None]:
+    """``RTPU_CLUSTER_PEERS`` resolved to a comma-separated spec, plus an
+    error string when an ``@/path/file`` form could not be read. The
+    error is DATA for ``/clusterz`` (``peers_error``) — a typo'd peer
+    file must not silently degrade to the derived localhost topology
+    with no hint why the configured mesh is dark."""
+    static = os.environ.get("RTPU_CLUSTER_PEERS", "").strip()
+    if static.startswith("@"):
+        path = static[1:]
+        try:
+            with open(path) as f:
+                static = ",".join(
+                    ln.strip() for ln in f
+                    if ln.strip() and not ln.lstrip().startswith("#"))
+        except OSError as e:
+            return "", f"unreadable RTPU_CLUSTER_PEERS file {path}: {e}"
+    return static, None
+
+
+def resolve_peers(n_processes: int | None = None,
+                  rest_port: int | None = None,
+                  host: str | None = None) -> tuple:
+    """Per-process REST base URLs, in process order.
+
+    ``RTPU_CLUSTER_PEERS`` wins when set. Otherwise derive from the
+    port-striding scheme: peer ``i`` on ``rest_port + i * stride`` at
+    ``RTPU_PEER_HOST`` (default 127.0.0.1). ``n_processes`` defaults to
+    ``jax.process_count()`` when jax is already imported (never imported
+    from here — this module stays stdlib-only), else 1."""
+    static, _ = _static_peer_spec()
+    if static:
+        out = []
+        for p in static.split(","):
+            p = p.strip()
+            if not p:
+                continue
+            if not p.startswith(("http://", "https://")):
+                p = f"http://{p}"
+            out.append(p.rstrip("/"))
+        return tuple(out)
+    if n_processes is None:
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                n_processes = int(jax.process_count())
+            except Exception:
+                n_processes = 1
+        else:
+            n_processes = 1
+    if rest_port is None:
+        from ..utils.config import Settings
+
+        rest_port = Settings().rest_port
+    host = host or os.environ.get("RTPU_PEER_HOST", "127.0.0.1")
+    return tuple(
+        f"http://{host}:{strided_port(rest_port, i)}"
+        for i in range(max(1, int(n_processes))))
+
+
+# -------------------------------------------------------------- scraping
+
+
+def _fetch_json(url: str, timeout: float) -> dict:
+    """One bounded-timeout GET returning parsed JSON. The caller's trace
+    context rides the X-RTPU-Trace header so the serve side joins the
+    scrape's trace. Raises on any transport/parse trouble — the caller
+    turns that into an ``unreachable`` row, never a 500."""
+    req = urllib.request.Request(url)
+    ctx = TRACER.capture()
+    if ctx is not None:
+        req.add_header(TraceContext.HEADER, ctx.to_wire())
+    with urllib.request.urlopen(req, timeout=timeout) as r:  # noqa: S310
+        return json.loads(r.read().decode())
+
+
+class PeerScraper:
+    """Fan-out scraper with a bounded, TTL'd last-snapshot cache.
+
+    The cache exists for poll-frequency callers (a dashboard refreshing
+    /clusterz at 1 Hz must not scrape the whole mesh every time) and is
+    bounded both ways: at most ``_CACHE_MAX`` peer entries (oldest
+    evicted — a churning RTPU_CLUSTER_PEERS can't grow it without bound)
+    and ``_CACHE_TTL_S`` seconds of staleness before a refetch. All
+    network I/O happens OUTSIDE the cache lock."""
+
+    def __init__(self, timeout_s: float | None = None,
+                 ttl_s: float = _CACHE_TTL_S):
+        self._timeout_s = timeout_s
+        self._ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._cache: dict[str, tuple[float, dict]] = {}
+
+    def _cached(self, urls: list[str]) -> dict[str, dict]:
+        now = time.monotonic()
+        with self._lock:
+            return {u: snap for u, (ts, snap) in self._cache.items()
+                    if u in urls and now - ts <= self._ttl_s}
+
+    def _store(self, results: dict[str, dict]) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for u, snap in results.items():
+                self._cache[u] = (now, snap)
+            while len(self._cache) > _CACHE_MAX:   # bounded: evict oldest
+                oldest = min(self._cache, key=lambda u: self._cache[u][0])
+                del self._cache[oldest]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cache.clear()
+
+    def scrape(self, urls: list[str], path: str = "/statusz",
+               refresh: bool = False) -> dict[str, dict]:
+        """Fetch ``url + path`` from every peer concurrently (bounded
+        timeout each). Returns url → snapshot; failures yield
+        ``{"reachable": False, "error": ...}``. ``refresh=True`` (and any
+        non-/statusz path) bypasses the cache."""
+        timeout = (self._timeout_s if self._timeout_s is not None
+                   else clusterz_timeout())
+        cacheable = path == "/statusz" and not refresh
+        out: dict[str, dict] = {}
+        todo = list(urls)
+        if cacheable:
+            hit = self._cached(todo)
+            out.update(hit)
+            todo = [u for u in todo if u not in hit]
+        if todo:
+            fetched: dict[str, dict] = {}
+            with TRACER.span("rest.scrape", peers=len(todo), path=path,
+                             process=TRACER.process_index):
+                # network fan-out: no lock held anywhere in this block
+                with ThreadPoolExecutor(
+                        max_workers=min(8, len(todo))) as pool:
+                    futs = {u: pool.submit(_fetch_json, u + path, timeout)
+                            for u in todo}
+                    for u, fut in futs.items():
+                        try:
+                            snap = fut.result()
+                            snap.setdefault("reachable", True)
+                            fetched[u] = snap
+                        except Exception as e:   # dead peer == data
+                            fetched[u] = {
+                                "reachable": False,
+                                "error": f"{type(e).__name__}: {e}"[:200]}
+            out.update(fetched)
+            if cacheable:
+                self._store({u: s for u, s in fetched.items()
+                             if s.get("reachable")})
+        return out
+
+
+#: process-wide scraper (the bounded cache is shared across requests)
+SCRAPER = PeerScraper()
+
+
+# ------------------------------------------------------------- federation
+
+
+def _peer_summary(status: dict) -> dict:
+    """The compact per-process row of the merged view, extracted from one
+    peer's /statusz snapshot (tolerant: older peers may lack blocks)."""
+    if not status.get("reachable", True):
+        return {"reachable": False, "error": status.get("error", "")}
+    cluster = status.get("cluster", {}) or {}
+    jobs = status.get("jobs", {}) or {}
+    coll = status.get("collectives", {}) or {}
+    routes = coll.get("routes", {}) or {}
+    wm = status.get("watermark", {}) or {}
+    return {
+        "reachable": True,
+        "process_index": cluster.get("process_index"),
+        "ports": cluster.get("ports", {}),
+        "watchdog": cluster.get("watchdog"),
+        "queue_depth": sum(1 for s in jobs.values()
+                           if s in _ACTIVE_STATUSES),
+        "jobs_total": len(jobs),
+        "watermark_lag_seconds": wm.get("lag_seconds"),
+        "safe_time": wm.get("safe_time"),
+        "log_events": status.get("log_events"),
+        "collectives": {
+            "routes": routes,
+            "skew": coll.get("skew"),
+            "barrier_wait_seconds": round(sum(
+                r.get("barrier_wait_seconds", 0.0)
+                for r in routes.values()), 6),
+        },
+    }
+
+
+def _merge_members(processes: dict) -> dict:
+    """Union of every reachable peer's watchdog membership, keyed by
+    role — each process's WatchDog only knows locally-joined members, so
+    the cluster view is the union with per-process attribution."""
+    merged: dict[str, dict] = {}
+    for name, p in processes.items():
+        wd = p.get("watchdog") if p.get("reachable") else None
+        if not wd:
+            continue
+        for role, ids in (wd.get("members") or {}).items():
+            r = merged.setdefault(role, {"count": 0, "by_process": {}})
+            r["count"] += len(ids)
+            r["by_process"][name] = ids
+    return merged
+
+
+def clusterz(manager=None, handler=None, trace_id: str | None = None,
+             refresh: bool = False, peers: list[str] | None = None) -> dict:
+    """The merged cluster view any process serves at ``/clusterz``.
+
+    The local process renders in-process (no HTTP hop to itself); every
+    other peer is scraped with bounded timeouts. ``trace_id`` adds a
+    cross-process trace reassembly block: every peer's
+    ``/tracez?trace_id=`` spans, grouped by process (span timestamps are
+    per-process perf_counter epochs — NOT comparable across processes;
+    the grouping preserves that honestly)."""
+    my_idx = process_index()
+    static_spec, peers_error = _static_peer_spec()
+    if peers is None:
+        base = (getattr(handler, "rest_base_port", None)
+                if handler else None)
+        peers = list(resolve_peers(rest_port=base))
+    # identify self: derived (strided-localhost) peers match on index or
+    # local bound port; static lists need the HOST too — every host of a
+    # real mesh binds the same port, so port alone would classify EVERY
+    # peer as self and federation would never scrape anyone. A static
+    # entry naming this host by a non-loopback address is scraped over
+    # HTTP like any peer (wasteful, never wrong).
+    my_port = getattr(handler, "rest_port", 0) if handler else 0
+
+    def _is_self(i: int, url: str) -> bool:
+        u = urllib.parse.urlsplit(url)
+        if static_spec or os.environ.get("RTPU_CLUSTER_PEERS"):
+            return bool(my_port) and u.port == my_port and \
+                u.hostname in ("127.0.0.1", "localhost", "::1")
+        return (bool(my_port) and u.port == my_port) or i == my_idx
+
+    remote = [u for i, u in enumerate(peers) if not _is_self(i, u)]
+    scraped = SCRAPER.scrape(remote, refresh=refresh)
+
+    processes: dict[str, dict] = {}
+    if manager is not None:
+        from ..jobs.rest import _statusz
+
+        local = _statusz(manager, handler)
+        local["reachable"] = True
+        processes[f"process_{my_idx}"] = _peer_summary(local)
+        processes[f"process_{my_idx}"]["self"] = True
+    for u in remote:
+        snap = scraped.get(u, {"reachable": False, "error": "not scraped"})
+        row = _peer_summary(snap)
+        row["url"] = u
+        idx = row.get("process_index")
+        key = f"process_{idx}" if idx is not None else u
+        processes[key] = row
+
+    reachable = sum(1 for p in processes.values() if p.get("reachable"))
+    out: dict = {
+        "process_index": my_idx,
+        "peers_configured": len(peers),
+        "processes_reachable": reachable,
+        "processes": processes,
+        "members": _merge_members(processes),
+        "stragglers": {
+            name: p["collectives"]["barrier_wait_seconds"]
+            for name, p in processes.items()
+            if p.get("reachable") and p.get("collectives")},
+    }
+    if peers_error:
+        out["peers_error"] = peers_error
+    if trace_id:
+        by_process: dict[str, list] = {}
+        if manager is not None:
+            by_process[f"process_{my_idx}"] = TRACER.for_trace(trace_id)
+        # ONE concurrent fan-out like the /statusz scrape above — a
+        # serial per-peer loop would stack dead peers' timeouts
+        q = urllib.parse.quote(trace_id, safe="")
+        scraped_t = SCRAPER.scrape(remote, path=f"/tracez?trace_id={q}",
+                                   refresh=True)
+        for u in remote:
+            t = scraped_t.get(u, {})
+            key = next((k for k, p in processes.items()
+                        if p.get("url") == u), u)
+            by_process[key] = (t.get("spans", [])
+                              if t.get("reachable", True) else [])
+        out["trace"] = {
+            "trace_id": trace_id,
+            "span_count": sum(len(v) for v in by_process.values()),
+            "processes_with_spans": sorted(
+                k for k, v in by_process.items() if v),
+            "by_process": by_process,
+        }
+    return out

@@ -413,6 +413,10 @@ class Ledger:
         self.fold_cache_misses = 0
         self.h2d_bytes = 0
         self.h2d_stall_seconds: dict[str, float] = {}
+        # cross-shard collective traffic by comm route (halo /
+        # all_gather / replicate) — the refined DCN/ICI bytes column
+        # next to est HBM bytes (parallel/sharded.py exchange accounting)
+        self.dcn: dict[str, dict] = {}
         self.kernels: dict[str, dict] = {}
         self.sweeps = 0
         self.views = 0
@@ -456,6 +460,21 @@ class Ledger:
                 for mode, sec in fold_modes.items():
                     self.fold_mode_seconds[mode] = (
                         self.fold_mode_seconds.get(mode, 0.0) + float(sec))
+
+    def add_dcn(self, route: str, *, rows: int, bytes_: int) -> None:
+        """One sharded dispatch's cross-shard exchange accounting
+        (``parallel/sharded.py``): estimated rows/bytes the collective
+        moved on ``route`` (halo / all_gather / replicate). Lands in the
+        ``dcn`` block of the ledger dict and the per-algorithm
+        ``raphtory_query_cost_dcn_bytes_total`` counter at publish."""
+        with self._lock:
+            d = self.dcn.get(route)
+            if d is None:
+                d = self.dcn[route] = {"dispatches": 0, "rows": 0,
+                                       "bytes": 0}
+            d["dispatches"] += 1
+            d["rows"] += max(0, int(rows))
+            d["bytes"] += max(0, int(bytes_))
 
     def count_dispatch(self, name: str, rec: dict) -> None:
         with self._lock:
@@ -505,6 +524,13 @@ class Ledger:
             for stage, sec in snap["h2d"]["stall_seconds"].items():
                 self.h2d_stall_seconds[stage] = (
                     self.h2d_stall_seconds.get(stage, 0.0) + sec)
+            for route, d in snap["dcn"]["routes"].items():
+                mine = self.dcn.get(route)
+                if mine is None:
+                    self.dcn[route] = dict(d)
+                else:
+                    for k in ("dispatches", "rows", "bytes"):
+                        mine[k] += d[k]
             for name, k in snap["device"]["kernels"].items():
                 mine = self.kernels.get(name)
                 if mine is None:
@@ -582,6 +608,11 @@ class Ledger:
             "h2d": {"bytes": int(self.h2d_bytes),
                     "stall_seconds": {s: round(v, 6) for s, v in
                                       self.h2d_stall_seconds.items()}},
+            "dcn": {
+                "bytes": sum(d["bytes"] for d in self.dcn.values()),
+                "rows": sum(d["rows"] for d in self.dcn.values()),
+                "routes": {r: dict(d) for r, d in self.dcn.items()},
+            },
             "device": {
                 "dispatches": sum(k["dispatches"]
                                   for k in self.kernels.values()),

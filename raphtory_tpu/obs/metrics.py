@@ -126,6 +126,58 @@ class Metrics:
         self.fold_cache_bytes = Gauge(
             "raphtory_fold_cache_bytes",
             "Bytes currently accounted to the fold cache", registry=r)
+        # collective telemetry (parallel/sharded.py, parallel/columns.py):
+        # what the cross-shard exchange MOVED per route — the evidence the
+        # sparse third collective route (ROADMAP item 3, "Sparse
+        # Allreduce" / "Node Aware SpMV") will be tuned against
+        self.collective_seconds = Counter(
+            "raphtory_collective_seconds_total",
+            "Wall seconds inside the collective window (dispatch to "
+            "local program completion) by comm route and edge direction",
+            ["route", "direction"], registry=r)
+        self.collective_bytes = Counter(
+            "raphtory_collective_bytes_total",
+            "Estimated cross-shard bytes moved by superstep exchanges "
+            "(halo slot pages or all_gather replication, summed over "
+            "devices and supersteps)", ["route", "direction"], registry=r)
+        self.collective_rows = Counter(
+            "raphtory_collective_rows_total",
+            "Cross-shard state rows moved by superstep exchanges",
+            ["route", "direction"], registry=r)
+        self.collective_barrier_wait = Counter(
+            "raphtory_collective_barrier_wait_seconds_total",
+            "Host seconds between local program completion and the "
+            "cross-process result allgather completing — the per-process "
+            "straggler-wait signal", ["route"], registry=r)
+        self.partition_skew = Gauge(
+            "raphtory_partition_skew",
+            "Max/mean per-shard row-count ratio of the latest partition "
+            "build (kind=edges_dst|edges_src|halo_dst|halo_src) — 1.0 is "
+            "perfectly balanced, power-law graphs drift high",
+            ["kind"], registry=r)
+        self.shard_rows = Histogram(
+            "raphtory_shard_rows",
+            "Per-shard row counts observed at partition build time "
+            "(one observation per shard per build)",
+            ["kind"],
+            buckets=(1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, float("inf")),
+            registry=r)
+        # cluster control plane (cluster/watchdog.py)
+        self.cluster_members = Gauge(
+            "raphtory_cluster_members",
+            "Live watchdog members by role (joined, beating, not downed)",
+            ["role"], registry=r)
+        self.cluster_stale = Gauge(
+            "raphtory_cluster_stale_members",
+            "Members past the staleness bar but not yet auto-downed",
+            registry=r)
+        # watermark lag (ingestion/watermark.py wires the callable — this
+        # module must not import it: watermark imports METRICS from here)
+        self.watermark_lag = Gauge(
+            "raphtory_watermark_lag_seconds",
+            "Seconds since this process's global safe time last advanced "
+            "(0 while the fence is moving; grows when a source stalls)",
+            registry=r)
         self.sweep_phase_seconds = Histogram(
             "raphtory_sweep_phase_seconds",
             "Per-sweep wall seconds by pipeline phase (fold=host delta "
@@ -181,6 +233,12 @@ class Metrics:
             "raphtory_query_cost_h2d_bytes_total",
             "Host->device bytes attributed to queries (TransferEngine "
             "deltas per sweep)", ["algorithm"], registry=r)
+        self.query_cost_dcn_bytes = Counter(
+            "raphtory_query_cost_dcn_bytes_total",
+            "Estimated cross-shard collective bytes attributed to "
+            "queries (parallel/sharded.py exchange accounting) — the "
+            "DCN/ICI column next to est HBM bytes in the ledger",
+            ["algorithm"], registry=r)
         # memory governor (Archivist signals)
         self.compactions = Counter(
             "raphtory_compactions_total",
@@ -209,13 +267,29 @@ def _rss_bytes() -> float:
 
 METRICS = Metrics()
 
+#: actual bound port of the last-started MetricsServer (0 = none) — what
+#: /statusz surfaces so /clusterz peers can scrape without hand-wiring
+_BOUND_PORT = [0]
+_BOUND_PORT_LOCK = threading.Lock()
+
+
+def bound_port() -> int:
+    with _BOUND_PORT_LOCK:
+        return _BOUND_PORT[0]
+
 
 class MetricsServer:
     """Embedded scrape endpoint (reference: Kamon Prometheus on :11600)."""
 
     def __init__(self, port: int = DEFAULT_PORT, addr: str = "0.0.0.0",
                  metrics: Metrics = METRICS):
-        self.port = port
+        from ..utils.config import strided_port
+
+        # auto-offset by jax.process_index() x RTPU_PORT_STRIDE so a
+        # multi-process localhost cluster never collides on :11600 —
+        # process 0 (and every single-process deployment) binds the
+        # configured port verbatim; port 0 stays ephemeral
+        self.port = strided_port(port)
         self.addr = addr
         self.metrics = metrics
         self._server = None
@@ -224,6 +298,11 @@ class MetricsServer:
     def start(self) -> "MetricsServer":
         self._server, self._thread = start_http_server(
             self.port, self.addr, registry=self.metrics.registry)
+        # surface the ACTUAL bound port (ephemeral port-0 binds resolve
+        # here) — what /statusz reports for /clusterz peer discovery
+        self.port = self._server.server_address[1]
+        with _BOUND_PORT_LOCK:
+            _BOUND_PORT[0] = self.port
         return self
 
     def stop(self) -> None:
@@ -231,6 +310,9 @@ class MetricsServer:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+            with _BOUND_PORT_LOCK:
+                if _BOUND_PORT[0] == self.port:
+                    _BOUND_PORT[0] = 0
         if self._thread is not None:
             # join the scrape-server thread so repeated start/stop in
             # tests can't leak threads; a bounded wait keeps a wedged

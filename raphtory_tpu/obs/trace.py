@@ -90,16 +90,25 @@ NULL_SPAN = _NullSpan()
 class TraceContext:
     """A (trace_id, span_id) pair captured on one thread and adopted on
     another — the request identity that crosses every pool handoff.
-    Immutable value object; build via ``Tracer.capture()``."""
+    ``origin`` is the process index the context was captured on (0 for
+    single-process runs): a context that crossed a REST hop keeps naming
+    the process that started the request. Immutable value object; build
+    via ``Tracer.capture()`` or parse one off the wire with
+    ``from_wire``."""
 
-    __slots__ = ("trace_id", "span_id")
+    __slots__ = ("trace_id", "span_id", "origin")
 
-    def __init__(self, trace_id: str, span_id: int):
+    #: HTTP header every REST hop / peer scrape carries the wire form in
+    HEADER = "X-RTPU-Trace"
+
+    def __init__(self, trace_id: str, span_id: int, origin: int = 0):
         self.trace_id = trace_id
         self.span_id = span_id
+        self.origin = origin
 
     def __repr__(self):
-        return f"TraceContext({self.trace_id!r}, {self.span_id})"
+        return (f"TraceContext({self.trace_id!r}, {self.span_id}, "
+                f"origin={self.origin})")
 
     def __eq__(self, other):
         return (isinstance(other, TraceContext)
@@ -111,6 +120,30 @@ class TraceContext:
         # object" that can't key a set/dict is a trap for callers
         # deduplicating captured contexts
         return hash((self.trace_id, self.span_id))
+
+    # ---- wire form (the X-RTPU-Trace header payload) ----
+
+    def to_wire(self) -> str:
+        """Compact header payload: ``trace_id;span_id;origin``. Trace ids
+        are already process-unique strings (pid + urandom prefix), so the
+        receiving process joins the trace by value — no id translation."""
+        return f"{self.trace_id};{self.span_id:x};{self.origin}"
+
+    @classmethod
+    def from_wire(cls, raw: str | None) -> "TraceContext | None":
+        """Parse a wire form back into a context. Tolerant: anything
+        malformed (truncated header, non-hex span id, empty string)
+        returns None — an observability header must never be able to
+        fail a request."""
+        if not raw:
+            return None
+        parts = str(raw).strip().split(";")
+        if len(parts) != 3 or not parts[0]:
+            return None
+        try:
+            return cls(parts[0], int(parts[1], 16), int(parts[2]))
+        except ValueError:
+            return None
 
 
 class _Adoption:
@@ -310,6 +343,16 @@ class Tracer:
         # per request) yet collision-free across processes in one capture
         self._trace_prefix = f"{os.getpid():x}-{os.urandom(3).hex()}"
         self._trace_ids = itertools.count(1)
+        # cluster identity: which PROCESS of a multi-host deployment this
+        # tracer records for. Seeded from RTPU_PROCESS_INDEX (plain
+        # multi-process deployments without jax.distributed), refined by
+        # cluster/bootstrap.py once jax.process_index() is known — this
+        # module must stay stdlib-importable, so jax is never asked here.
+        try:
+            self.process_index = max(
+                0, int(os.environ.get("RTPU_PROCESS_INDEX", "0") or 0))
+        except ValueError:
+            self.process_index = 0
         # extra dump payloads (the sampling profiler registers one):
         # name → zero-arg callable returning a JSON-able block or None
         self._aux: dict[str, object] = {}
@@ -424,8 +467,16 @@ class Tracer:
             return None
         st = self._stack()
         if st:
-            return TraceContext(st[-1].trace, st[-1].sid)
+            return TraceContext(st[-1].trace, st[-1].sid,
+                                self.process_index)
         return getattr(self._local, "adopted", None)
+
+    def set_process_index(self, index: int) -> None:
+        """Record which process of a multi-host deployment this tracer
+        belongs to — called by ``cluster/bootstrap.bootstrap()`` once
+        ``jax.process_index()`` is known. Captured contexts carry it as
+        their origin, and ``block_steps`` tags barrier spans with it."""
+        self.process_index = max(0, int(index))
 
     def adopt(self, ctx: TraceContext | None) -> _Adoption:
         """Install ``ctx`` as this thread's ambient trace context for the
@@ -606,7 +657,8 @@ def block_steps(fn):
     program's results land — under ONE ``superstep.block`` span carrying
     the superstep count. The single definition of the barrier span shared
     by the engine layer (``bsp.run``) and every jobs-layer emit path."""
-    with TRACER.span("superstep.block") as sp:
+    with TRACER.span("superstep.block",
+                     process=TRACER.process_index) as sp:
         value, steps = fn()
         steps = int(steps)
         sp.set(steps=steps)

@@ -19,6 +19,8 @@ whole mesh.
 
 from __future__ import annotations
 
+import time as _time
+
 import numpy as np
 
 import jax
@@ -101,19 +103,51 @@ def run_columns_sharded(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
                   P(C_AXIS), P(C_AXIS), P(C_AXIS), *extra_specs),
         out_specs=(P(C_AXIS), P(C_AXIS))))
 
+    from ..obs.trace import TRACER
+    from .sharded import COLLECTIVES
+
     repl = NamedSharding(mesh, P())
     put = lambda a: jax.device_put(jnp.asarray(a), repl)
-    result, steps = shard(
-        put(tables.e_src), put(tables.e_dst), put(e_lat), put(e_alive),
-        put(v_lat), put(v_alive),
-        jnp.asarray(hop_of_col), jnp.asarray(T_col), jnp.asarray(w_col),
-        *(put(a) for a in extra_host))
-    if jax.process_count() > 1:
-        # the columns span processes' devices — replicate back to every
-        # host (reducers are host code), like parallel/sharded.py does
-        from jax.experimental import multihost_utils
+    # the only cross-chip traffic on this route is the one-time
+    # replicated-table broadcast — account it as the "replicate" route
+    # (rows = table rows, bytes = table payload x devices receiving it)
+    repl_arrays = [tables.e_src, tables.e_dst, e_lat, e_alive, v_lat,
+                   v_alive, *extra_host]
+    repl_bytes = int(sum(np.asarray(a).nbytes for a in repl_arrays))
+    repl_rows = int(sum(np.asarray(a).shape[-1] if np.asarray(a).ndim
+                        else 1 for a in repl_arrays))
+    proc = TRACER.process_index
+    multi = len({d.process_index for d in mesh.devices.flat}) > 1
+    t0 = _time.perf_counter()
+    with TRACER.span("comm.exchange", route="replicate",
+                     direction="columns", process=proc,
+                     shards=n_dev, rows=repl_rows * max(1, n_dev - 1),
+                     bytes=repl_bytes * max(1, n_dev - 1)):
+        result, steps = shard(
+            put(tables.e_src), put(tables.e_dst), put(e_lat), put(e_alive),
+            put(v_lat), put(v_alive),
+            jnp.asarray(hop_of_col), jnp.asarray(T_col), jnp.asarray(w_col),
+            *(put(a) for a in extra_host))
+        barrier_wait = 0.0
+        if multi:
+            # the columns span processes' devices — replicate back to
+            # every host (reducers are host code), like
+            # parallel/sharded.py does. This wait is the per-process
+            # straggler signal on the column-sharded route.
+            from jax.experimental import multihost_utils
 
-        result = multihost_utils.process_allgather(result, tiled=True)
-        steps = multihost_utils.process_allgather(steps, tiled=True)
-        return result[:C], int(np.max(steps))
+            jax.block_until_ready(result)
+            t_bar = _time.perf_counter()
+            with TRACER.span("comm.barrier_wait", route="replicate",
+                             process=proc):
+                result = multihost_utils.process_allgather(result,
+                                                           tiled=True)
+                steps = multihost_utils.process_allgather(steps,
+                                                          tiled=True)
+            barrier_wait = _time.perf_counter() - t_bar
+    COLLECTIVES.note_exchange(
+        "replicate", "columns", rows=repl_rows * max(1, n_dev - 1),
+        bytes_=repl_bytes * max(1, n_dev - 1),
+        seconds=_time.perf_counter() - t0, supersteps=1,
+        barrier_wait=barrier_wait)
     return result[:C], int(np.max(np.asarray(steps)))

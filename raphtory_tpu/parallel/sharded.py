@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time as _time
 from dataclasses import dataclass
 
 import jax
@@ -47,10 +48,139 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..core.snapshot import GraphView, INT64_MIN
 from ..engine.bsp import _elem, _merge_aggs
 from ..engine.program import Context, Edges, VertexProgram
+from ..obs.trace import TRACER
 from ..ops.segment import segment_combine
 
 V_AXIS = "vertices"
 W_AXIS = "windows"
+
+
+def _metrics():
+    """obs.metrics bundle, or None when prometheus isn't importable —
+    collective telemetry must never make prometheus a hard dependency
+    of the compute path."""
+    try:
+        from ..obs.metrics import METRICS
+
+        return METRICS
+    except Exception:
+        return None
+
+
+class CollectiveStats:
+    """Process-wide accounting of what the cross-shard exchanges moved —
+    the measured evidence ROADMAP item 3's sparse third collective route
+    will be chosen against ("Sparse Allreduce": exchange only nonzero
+    frontier slices; "Node Aware SpMV": aggregate intra-host before
+    crossing DCN — both need per-route volume and skew numbers first).
+
+    Thread-safe (concurrent mesh jobs dispatch from their own job
+    threads); surfaced at ``/statusz`` and federated by ``/clusterz``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._routes: dict[tuple, dict] = {}
+        self._skew: dict | None = None
+        self._skew_builds = 0
+
+    def note_partition(self, skew: dict) -> None:
+        """Record the latest partition build's per-shard skew histogram
+        (built at ``partition_view`` time — rebuilds overwrite)."""
+        with self._lock:
+            self._skew = skew
+            self._skew_builds += 1
+
+    def note_exchange(self, route: str, direction: str, *, rows: int,
+                      bytes_: int, seconds: float, supersteps: int,
+                      barrier_wait: float = 0.0,
+                      async_dispatch: bool = False) -> None:
+        """One dispatch's exchange accounting. ``rows``/``bytes_`` are
+        totals over devices and (known) supersteps; async dispatches
+        can't know their superstep count host-side and account exactly
+        one superstep, counted separately so the undercount is visible."""
+        with self._lock:
+            d = self._routes.setdefault((route, direction), {
+                "dispatches": 0, "supersteps": 0, "rows": 0, "bytes": 0,
+                "seconds": 0.0, "barrier_wait_seconds": 0.0,
+                "async_dispatches": 0})
+            d["dispatches"] += 1
+            d["supersteps"] += int(supersteps)
+            d["rows"] += int(rows)
+            d["bytes"] += int(bytes_)
+            d["seconds"] += float(seconds)
+            d["barrier_wait_seconds"] += float(barrier_wait)
+            if async_dispatch:
+                d["async_dispatches"] += 1
+        m = _metrics()
+        if m is not None:
+            m.collective_seconds.labels(route, direction).inc(
+                max(0.0, float(seconds)))
+            m.collective_bytes.labels(route, direction).inc(
+                max(0, int(bytes_)))
+            m.collective_rows.labels(route, direction).inc(
+                max(0, int(rows)))
+            if barrier_wait > 0.0:
+                m.collective_barrier_wait.labels(route).inc(
+                    float(barrier_wait))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            routes = {f"{r}/{d}": dict(v)
+                      for (r, d), v in sorted(self._routes.items())}
+            skew = dict(self._skew) if self._skew else None
+            builds = self._skew_builds
+        for v in routes.values():
+            v["seconds"] = round(v["seconds"], 6)
+            v["barrier_wait_seconds"] = round(
+                v["barrier_wait_seconds"], 6)
+        return {"routes": routes, "skew": skew, "skew_builds": builds}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._routes.clear()
+            self._skew = None
+            self._skew_builds = 0
+
+
+#: process-wide collective accounting every mesh dispatch records into
+COLLECTIVES = CollectiveStats()
+
+
+def shard_skew(**kinds) -> dict:
+    """Per-shard row-count skew summary: for each named kind (an array of
+    per-shard counts), the per-shard histogram plus max/mean — the
+    power-law imbalance signal. ``skew`` 1.0 = perfectly balanced."""
+    out = {}
+    for kind, arr in kinds.items():
+        a = np.asarray(arr, np.float64).reshape(-1)
+        mean = float(a.mean()) if a.size else 0.0
+        mx = float(a.max()) if a.size else 0.0
+        out[kind] = {
+            "per_shard": [int(x) for x in a],
+            "max": int(mx),
+            "mean": round(mean, 2),
+            "skew": round(mx / mean, 4) if mean > 0 else 1.0,
+        }
+    return out
+
+
+def note_partition_skew(skew: dict) -> None:
+    """Publish one partition build's skew histogram: COLLECTIVES (the
+    /statusz / /clusterz surface), the prometheus gauges/histograms, and
+    a flight-recorder instant — shared by ``partition_view`` and the
+    static ``ShardedSweep`` build."""
+    COLLECTIVES.note_partition(skew)
+    m = _metrics()
+    if m is not None:
+        for kind, s in skew.items():
+            m.partition_skew.labels(kind).set(s["skew"])
+            for rows in s["per_shard"]:
+                m.shard_rows.labels(kind).observe(float(rows))
+    if TRACER.enabled:
+        TRACER.instant("comm.partition",
+                       process=TRACER.process_index,
+                       **{f"{k}_skew": v["skew"] for k, v in skew.items()})
 
 
 def _shard_map(fn, *, mesh, in_specs, out_specs):
@@ -120,6 +250,9 @@ class ShardedView:
     h_s: int = 0
     s_dst_h: np.ndarray | None = None
     s_send: np.ndarray | None = None
+    #: per-shard degree/halo row-count histogram built at partition time
+    #: (``shard_skew`` output) — the power-law imbalance evidence
+    skew: dict | None = None
 
     def halo_rows(self, direction: str) -> int:
         """Rows exchanged per device per superstep on the halo path (vs
@@ -140,13 +273,16 @@ def _build_halo(idx_g: np.ndarray, n_loc: int, S: int):
     """Halo layout for one partition direction.
 
     ``idx_g[S, m_loc]`` holds GLOBAL vertex refs per shard. Returns
-    ``(h, idx_h, send)``: per-(requester, owner) slot capacity ``h``;
-    ``idx_h[S, m_loc]`` remapping each ref into the shard's extended space —
-    local row for own vertices, ``n_loc + owner*h + slot`` for remote ones;
-    ``send[S, S*h]`` where row ``o`` is owner-device o's all_to_all send
-    page: chunk ``r`` lists the local rows requester ``r`` referenced
-    (sorted unique; slot order matches the requester's remap)."""
+    ``(h, idx_h, send, halo_counts)``: per-(requester, owner) slot
+    capacity ``h``; ``idx_h[S, m_loc]`` remapping each ref into the
+    shard's extended space — local row for own vertices,
+    ``n_loc + owner*h + slot`` for remote ones; ``send[S, S*h]`` where
+    row ``o`` is owner-device o's all_to_all send page: chunk ``r`` lists
+    the local rows requester ``r`` referenced (sorted unique; slot order
+    matches the requester's remap); ``halo_counts[S]`` counts each
+    requester's unique remote refs (the per-shard halo-skew signal)."""
     idx_h = np.zeros(idx_g.shape, np.int32)
+    halo_counts = np.zeros(idx_g.shape[0], np.int64)
     uniq = []  # (requester, u_owner[], u_g[], slot[])
     maxcnt = 1
     for sh in range(S):
@@ -172,6 +308,7 @@ def _build_halo(idx_g: np.ndarray, n_loc: int, S: int):
         base = np.maximum.accumulate(np.where(o_change, arange_u, 0))
         slot = (arange_u - base).astype(np.int64)
         maxcnt = max(maxcnt, int(slot.max()) + 1)
+        halo_counts[sh] = len(u_g)
         # remote-row remap happens in the second pass (slots need final h)
         uniq.append((sh, u_owner, u_g, slot, rem[order], uid))
     h = _pow2(maxcnt)
@@ -179,7 +316,7 @@ def _build_halo(idx_g: np.ndarray, n_loc: int, S: int):
     for sh, u_owner, u_g, slot, rows, uid in uniq:
         idx_h[sh, rows] = (n_loc + u_owner[uid] * h + slot[uid]).astype(np.int32)
         send[u_owner, sh * h + slot] = (u_g - u_owner * n_loc).astype(np.int32)
-    return h, idx_h, send
+    return h, idx_h, send, halo_counts
 
 
 # build counter — the amortisation witness: range sweeps that re-partition
@@ -235,6 +372,7 @@ def partition_view(view: GraphView, n_shards: int,
         owner = owner_of // n_loc
         order = np.lexsort((local_of, owner))
         counts = np.bincount(owner, minlength=S)
+        shard_counts.append(np.asarray(counts[:S], np.int64))
         m_loc = _pow2(int(counts.max()) if len(counts) else 0)
         idx_g = np.full((S, m_loc), view.n_pad - 1, np.int32)
         idx_l = np.full((S, m_loc), n_loc - 1, np.int32)
@@ -256,13 +394,20 @@ def partition_view(view: GraphView, n_shards: int,
                 parr[kk][sh, :c] = props[kk][rows]
         return m_loc, idx_g, idx_l, mask, tarr, farr, parr
 
+    shard_counts: list = []   # filled by _partition (dst then src)
     m_loc_d, d_src_g, d_dst_l, d_mask, d_time, d_first, d_props = _partition(
         edst, edst % n_loc, esrc)
     m_loc_s, s_dst_g, s_src_l, s_mask, s_time, s_first, s_props = _partition(
         esrc, esrc % n_loc, edst)
 
-    h_d, d_src_h, d_send = _build_halo(d_src_g, n_loc, S)
-    h_s, s_dst_h, s_send = _build_halo(s_dst_g, n_loc, S)
+    h_d, d_src_h, d_send, halo_d = _build_halo(d_src_g, n_loc, S)
+    h_s, s_dst_h, s_send, halo_s = _build_halo(s_dst_g, n_loc, S)
+
+    # per-shard degree/halo histogram — the partition-time skew evidence
+    # (power-law graphs concentrate edges and halo refs on few shards)
+    skew = shard_skew(edges_dst=shard_counts[0], edges_src=shard_counts[1],
+                      halo_dst=halo_d, halo_src=halo_s)
+    note_partition_skew(skew)
 
     rs = lambda a: a.reshape(S, n_loc)
     return ShardedView(
@@ -277,6 +422,7 @@ def partition_view(view: GraphView, n_shards: int,
         occurrences=occurrences,
         h_d=h_d, d_src_h=d_src_h, d_send=d_send,
         h_s=h_s, s_dst_h=s_dst_h, s_send=s_send,
+        skew=skew,
     )
 
 
@@ -614,8 +760,11 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
     # (data-replicated ingestion — the reference replays every update to
     # every PM's router the same way), so each input becomes a GLOBAL
     # jax.Array by slicing out this process's addressable shards. On one
-    # process this degrades to a plain device put.
-    multi = jax.process_count() > 1
+    # process this degrades to a plain device put. Gated on the MESH
+    # actually spanning processes, not on jax.process_count(): a process
+    # of a multi-host cluster sweeping its own local devices must not
+    # attempt a cross-process allgather of a locally-addressable result.
+    multi = len({d.process_index for d in mesh.devices.flat}) > 1
 
     def dev(x, spec):
         if not multi:
@@ -630,30 +779,89 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
         halo = {"d_src_h": dev(sv.d_src_h, v), "d_send": dev(sv.d_send, v),
                 "s_dst_h": dev(sv.s_dst_h, v), "s_send": dev(sv.s_send, v)}
 
-    result, steps = runner(
-        dev(v_masks, kv), dev(sv.vids, v), dev(sv.v_latest, v),
-        dev(sv.v_first, v),
-        dev(sv.d_src_g, v), dev(sv.d_dst_l, v), dev(d_masks, kv),
-        dev(sv.d_time, v), dev(sv.d_first, v),
-        dev(sv.s_dst_g, v), dev(sv.s_src_l, v), dev(s_masks, kv),
-        dev(sv.s_time, v), dev(sv.s_first, v),
-        halo,
-        {kk: dev(vv, v) for kk, vv in sv.d_props.items()},
-        {kk: dev(vv, v) for kk, vv in sv.s_props.items()},
-        {kk: dev(
-            np.asarray(view.vertex_prop(kk), np.float32).reshape(S, sv.n_loc),
-            v)
-         for kk in program.vertex_props},
-        dev(np.asarray(view.time, np.int64), rep),
-        dev(np.asarray(wlist_p, np.int64), P(W_AXIS)),
-    )
-    if multi:
-        # replicate the (cross-host sharded) result back to every host —
-        # job reducers are host code and expect the full arrays
-        from jax.experimental import multihost_utils
+    # Collective telemetry: what THIS dispatch moves across shards per
+    # superstep. halo ships each device its referenced remote slot pages
+    # (padded slot capacity — what is actually on the wire); all_gather
+    # replicates the (n_pad - n_loc) remote rows to every device once per
+    # superstep, shared by both directions. Byte width is estimated from
+    # the result state leaves (the exchanged state tree for every vertex
+    # program this engine runs; a program with wider internal state
+    # under-counts — documented in docs/OBSERVABILITY.md).
+    n_devices = int(mesh.devices.size)
+    if comm == "halo":
+        rows_dev = sv.halo_rows(program.direction)
+    else:
+        rows_dev = view.n_pad - sv.n_loc
+    rows_step = rows_dev * k_loc * n_devices
+    proc = TRACER.process_index
+    with TRACER.span("comm.exchange", route=comm,
+                     direction=program.direction, process=proc,
+                     shards=S, windows=k_pad,
+                     rows_per_superstep=rows_step) as csp:
+        result, steps = runner(
+            dev(v_masks, kv), dev(sv.vids, v), dev(sv.v_latest, v),
+            dev(sv.v_first, v),
+            dev(sv.d_src_g, v), dev(sv.d_dst_l, v), dev(d_masks, kv),
+            dev(sv.d_time, v), dev(sv.d_first, v),
+            dev(sv.s_dst_g, v), dev(sv.s_src_l, v), dev(s_masks, kv),
+            dev(sv.s_time, v), dev(sv.s_first, v),
+            halo,
+            {kk: dev(vv, v) for kk, vv in sv.d_props.items()},
+            {kk: dev(vv, v) for kk, vv in sv.s_props.items()},
+            {kk: dev(
+                np.asarray(view.vertex_prop(kk),
+                           np.float32).reshape(S, sv.n_loc),
+                v)
+             for kk in program.vertex_props},
+            dev(np.asarray(view.time, np.int64), rep),
+            dev(np.asarray(wlist_p, np.int64), P(W_AXIS)),
+        )
+        t_disp = _time.perf_counter()
+        row_bytes = sum(
+            np.dtype(a.dtype).itemsize
+            * int(np.prod(a.shape[3:], dtype=np.int64))
+            for a in jax.tree_util.tree_leaves(result))
+        block_wait = barrier_wait = 0.0
+        if block or multi:
+            # local program completion: device compute + in-program
+            # collectives — the host-side "collective window"
+            with TRACER.span("comm.block_wait", route=comm, process=proc):
+                jax.block_until_ready(result)
+            block_wait = _time.perf_counter() - t_disp
+        if multi:
+            # replicate the (cross-host sharded) result back to every
+            # host — job reducers are host code and expect the full
+            # arrays. Local compute is DONE here, so this wait is the
+            # per-process straggler signal: a process stuck behind a
+            # slow peer spends it in this span.
+            from jax.experimental import multihost_utils
 
-        result = multihost_utils.process_allgather(result, tiled=True)
-        block = True
+            t_bar = _time.perf_counter()
+            with TRACER.span("comm.barrier_wait", route=comm,
+                             process=proc):
+                result = multihost_utils.process_allgather(
+                    result, tiled=True)
+            barrier_wait = _time.perf_counter() - t_bar
+            block = True
+        # superstep count is a device scalar on async dispatches — those
+        # account exactly one superstep (visible as async_dispatches in
+        # the COLLECTIVES snapshot) rather than blocking the overlap the
+        # async path exists for
+        n_steps = int(steps) if block else 1
+        rows_total = rows_step * n_steps
+        bytes_total = rows_total * row_bytes
+        csp.set(supersteps=(n_steps if block else "async"),
+                rows=rows_total, bytes=bytes_total,
+                barrier_wait_seconds=round(barrier_wait, 6))
+    COLLECTIVES.note_exchange(
+        comm, program.direction, rows=rows_total, bytes_=bytes_total,
+        seconds=block_wait, supersteps=n_steps,
+        barrier_wait=barrier_wait, async_dispatch=not block)
+    from ..obs import ledger as _ledger
+
+    led = _ledger.current()
+    if led is not None:
+        led.add_dcn(comm, rows=rows_total, bytes_=bytes_total)
     # merge shard axis back into global vertex order: [K, S, n_loc] -> [K, n]
     to_host = np.asarray if block else (lambda a: a)
     result = jax.tree_util.tree_map(

@@ -77,8 +77,16 @@ class ShardedSweep:
             edst, edst % n_loc, esrc)
         m_s, s_dst_g, s_src_l, self._s_shard, self._s_slot = build(
             esrc, esrc % n_loc, edst)
-        h_d, d_src_h, d_send = _build_halo(d_src_g, n_loc, S)
-        h_s, s_dst_h, s_send = _build_halo(s_dst_g, n_loc, S)
+        h_d, d_src_h, d_send, halo_d = _build_halo(d_src_g, n_loc, S)
+        h_s, s_dst_h, s_send, halo_s = _build_halo(s_dst_g, n_loc, S)
+
+        # per-shard degree/halo skew of the ONE static partition this
+        # sweep amortises over every hop — same surface as partition_view
+        skew = sharded.shard_skew(
+            edges_dst=np.bincount(self._d_shard, minlength=S),
+            edges_src=np.bincount(self._s_shard, minlength=S),
+            halo_dst=halo_d, halo_src=halo_s)
+        sharded.note_partition_skew(skew)
 
         # mutable fold-state blocks (alive masks + latest times), all-dead
         def blk(m_loc, fill, dt):
@@ -101,6 +109,7 @@ class ShardedSweep:
             d_props={}, s_props={}, view=None,
             h_d=h_d, d_src_h=d_src_h, d_send=d_send,
             h_s=h_s, s_dst_h=s_dst_h, s_send=s_send,
+            skew=skew,
         )
         self._shell = _Shell(time=0, n_pad=t.n_pad, vids=t.vids,
                              v_mask=self.sv.v_mask.reshape(-1),

@@ -35,6 +35,55 @@ def _env_str(name: str, default: str) -> str:
     return os.environ.get(name, default)
 
 
+def process_index() -> int:
+    """This process's index in a multi-process deployment.
+
+    Resolution order: ``RTPU_PROCESS_INDEX`` (explicit — plain
+    multi-process deployments that never call ``jax.distributed``), then
+    ``jax.process_index()`` when jax is ALREADY imported (a serving
+    process always has it; never imported from here, so stripped
+    environments and pre-``jax.distributed.initialize`` code paths are
+    untouched), else 0."""
+    v = os.environ.get("RTPU_PROCESS_INDEX")
+    if v:
+        try:
+            return max(0, int(v))
+        except ValueError:
+            pass
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:
+            return 0
+    return 0
+
+
+def port_stride() -> int:
+    """``RTPU_PORT_STRIDE`` (default 1): per-process listen-port offset
+    multiplier. 0 disables striding (every process binds the configured
+    port verbatim — the single-process behaviour)."""
+    try:
+        return max(0, int(os.environ.get("RTPU_PORT_STRIDE", "1") or 1))
+    except ValueError:
+        return 1
+
+
+def strided_port(base: int, index: int | None = None) -> int:
+    """Auto-offset a listen port by this process's index so an N-process
+    localhost cluster never collides on the fixed REST/metrics ports:
+    ``base + index * RTPU_PORT_STRIDE``. Port 0 (ephemeral, tests) is
+    never offset, and process 0 always binds ``base`` — single-process
+    deployments see no change."""
+    base = int(base)
+    if base == 0:
+        return 0
+    idx = process_index() if index is None else max(0, int(index))
+    return base + idx * port_stride()
+
+
 def configure_compile_cache() -> str | None:
     """Wire JAX's persistent compilation cache to ``RTPU_COMPILE_CACHE_DIR``.
 

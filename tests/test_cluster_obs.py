@@ -1,0 +1,489 @@
+"""Cluster observability plane: cross-process trace wire form, port
+striding, collective telemetry, watchdog transition signals, watermark
+lag, and /clusterz federation (ISSUE 10)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raphtory_tpu.cluster.watchdog import WatchDog
+from raphtory_tpu.obs.cluster import (
+    SCRAPER,
+    PeerScraper,
+    resolve_peers,
+)
+from raphtory_tpu.obs.metrics import METRICS
+from raphtory_tpu.obs.trace import TRACER, TraceContext
+from raphtory_tpu.parallel.sharded import (
+    COLLECTIVES,
+    CollectiveStats,
+    shard_skew,
+)
+from raphtory_tpu.utils.config import (
+    Settings,
+    port_stride,
+    process_index,
+    strided_port,
+)
+
+
+@pytest.fixture
+def traced():
+    was = TRACER.enabled
+    TRACER.enable()
+    TRACER.clear()
+    try:
+        yield TRACER
+    finally:
+        TRACER.enabled = was
+
+
+def _gauge(name, labels=None):
+    return METRICS.registry.get_sample_value(name, labels or {})
+
+
+# ---- TraceContext wire form ----
+
+def test_trace_context_wire_roundtrip():
+    ctx = TraceContext("abc-def-7", 0x2A, origin=3)
+    back = TraceContext.from_wire(ctx.to_wire())
+    assert back == ctx and back.origin == 3
+    assert back.span_id == 42
+
+
+@pytest.mark.parametrize("raw", [
+    None, "", "justtrace", "a;b", "t;nothex;0", ";1f;0", "a;1f;NaN",
+    "a;1f;0;extra",
+])
+def test_trace_context_wire_malformed_returns_none(raw):
+    # an observability header must never be able to fail a request
+    assert TraceContext.from_wire(raw) is None
+
+
+def test_capture_carries_process_index(traced):
+    old = TRACER.process_index
+    try:
+        TRACER.set_process_index(5)
+        with TRACER.span("x"):
+            ctx = TRACER.capture()
+        assert ctx.origin == 5
+    finally:
+        TRACER.set_process_index(old)
+
+
+# ---- port striding ----
+
+def test_strided_port_defaults(monkeypatch):
+    monkeypatch.delenv("RTPU_PROCESS_INDEX", raising=False)
+    monkeypatch.delenv("RTPU_PORT_STRIDE", raising=False)
+    assert strided_port(8081, 0) == 8081      # process 0 binds verbatim
+    assert strided_port(8081, 3) == 8084
+    assert strided_port(0, 3) == 0            # ephemeral is never offset
+
+
+def test_strided_port_env(monkeypatch):
+    monkeypatch.setenv("RTPU_PROCESS_INDEX", "2")
+    monkeypatch.setenv("RTPU_PORT_STRIDE", "10")
+    assert process_index() == 2
+    assert port_stride() == 10
+    assert strided_port(11600) == 11620
+    monkeypatch.setenv("RTPU_PORT_STRIDE", "0")   # striding disabled
+    assert strided_port(11600) == 11600
+
+
+def test_process_index_garbage_env(monkeypatch):
+    monkeypatch.setenv("RTPU_PROCESS_INDEX", "banana")
+    assert process_index() >= 0   # falls through, never raises
+
+
+# ---- collective accounting ----
+
+def test_collective_stats_accounting():
+    cs = CollectiveStats()
+    cs.note_exchange("halo", "dst", rows=100, bytes_=800, seconds=0.5,
+                     supersteps=4, barrier_wait=0.1)
+    cs.note_exchange("halo", "dst", rows=50, bytes_=400, seconds=0.25,
+                     supersteps=2)
+    cs.note_exchange("all_gather", "src", rows=10, bytes_=80, seconds=0.1,
+                     supersteps=1, async_dispatch=True)
+    snap = cs.snapshot()
+    hd = snap["routes"]["halo/dst"]
+    assert hd["dispatches"] == 2 and hd["supersteps"] == 6
+    assert hd["rows"] == 150 and hd["bytes"] == 1200
+    assert hd["barrier_wait_seconds"] == pytest.approx(0.1)
+    assert snap["routes"]["all_gather/src"]["async_dispatches"] == 1
+    cs.clear()
+    assert cs.snapshot()["routes"] == {}
+
+
+def test_collective_metrics_flow():
+    before = _gauge("raphtory_collective_bytes_total",
+                    {"route": "halo", "direction": "test"}) or 0.0
+    COLLECTIVES.note_exchange("halo", "test", rows=5, bytes_=1000,
+                              seconds=0.01, supersteps=1,
+                              barrier_wait=0.02)
+    after = _gauge("raphtory_collective_bytes_total",
+                   {"route": "halo", "direction": "test"})
+    assert after == before + 1000
+    assert _gauge("raphtory_collective_barrier_wait_seconds_total",
+                  {"route": "halo"}) > 0
+
+
+def test_shard_skew_math():
+    s = shard_skew(edges=np.array([100, 100, 100, 100]))
+    assert s["edges"]["skew"] == 1.0
+    s = shard_skew(edges=np.array([300, 100, 100, 100]))
+    assert s["edges"]["skew"] == 2.0      # max 300 / mean 150
+    assert s["edges"]["per_shard"] == [300, 100, 100, 100]
+    s = shard_skew(empty=np.array([]))
+    assert s["empty"]["skew"] == 1.0      # degenerate: balanced
+
+
+def test_partition_view_records_skew(traced):
+    from raphtory_tpu.core.events import EventLog
+    from raphtory_tpu.core.snapshot import build_view
+    from raphtory_tpu.parallel.sharded import partition_view
+
+    rng = np.random.default_rng(1)
+    log = EventLog()
+    for _ in range(300):
+        t = int(rng.integers(0, 50))
+        log.add_edge(t, int(rng.integers(0, 20)),
+                     int(rng.integers(0, 20)))
+    view = build_view(log, 50)
+    sv = partition_view(view, 2)
+    assert sv.skew is not None
+    for kind in ("edges_dst", "edges_src", "halo_dst", "halo_src"):
+        assert kind in sv.skew
+        assert len(sv.skew[kind]["per_shard"]) == 2
+        assert sv.skew[kind]["skew"] >= 1.0
+    # published: COLLECTIVES snapshot + the gauge + the instant
+    assert COLLECTIVES.snapshot()["skew"] is not None
+    assert _gauge("raphtory_partition_skew",
+                  {"kind": "edges_dst"}) >= 1.0
+    assert any(e["name"] == "comm.partition"
+               for e in TRACER.recent(100))
+
+
+# ---- watchdog transition signals ----
+
+def test_watchdog_join_emits_instant_and_gauge(traced):
+    wd = WatchDog(Settings(min_shards=1, min_sources=0))
+    wd.join("shard")
+    assert _gauge("raphtory_cluster_members", {"role": "shard"}) == 1
+    joins = [e for e in TRACER.recent(50)
+             if e["name"] == "cluster.join"]
+    assert joins and joins[-1]["args"]["role"] == "shard"
+
+
+def test_watchdog_stale_auto_down_rejoin_signals(traced):
+    clk = {"t": 0.0}
+    wd = WatchDog(Settings(stale_after_s=30, auto_down_after_s=1200,
+                           min_shards=1, min_sources=0),
+                  clock=lambda: clk["t"])
+    sid = wd.join("shard")
+    assert _gauge("raphtory_cluster_members", {"role": "shard"}) == 1
+
+    # missed beats → stale: ONE instant per episode, gauge reflects it
+    clk["t"] = 31.0
+    assert wd.stale() == [("shard", sid, 31.0)]
+    assert _gauge("raphtory_cluster_stale_members") == 1
+    n_stale = sum(1 for e in TRACER.recent(100)
+                  if e["name"] == "cluster.stale")
+    assert n_stale == 1
+    wd.stale()   # still stale; the episode must not re-emit
+    assert sum(1 for e in TRACER.recent(100)
+               if e["name"] == "cluster.stale") == 1
+
+    # silent past the auto-down bar → downed: instant + gauges drop
+    clk["t"] = 1201.0
+    assert wd.auto_down() == [("shard", sid)]
+    assert _gauge("raphtory_cluster_members", {"role": "shard"}) == 0
+    assert _gauge("raphtory_cluster_stale_members") == 0
+    downs = [e for e in TRACER.recent(100)
+             if e["name"] == "cluster.auto_down"]
+    assert downs and downs[-1]["args"]["id"] == sid
+    assert not wd.cluster_up()
+
+    # a beat revives: rejoin instant + gauge restored
+    assert wd.beat("shard", sid)
+    assert _gauge("raphtory_cluster_members", {"role": "shard"}) == 1
+    assert any(e["name"] == "cluster.rejoin" for e in TRACER.recent(100))
+    assert wd.cluster_up()
+
+
+def test_watchdog_stale_episode_clears_on_beat(traced):
+    clk = {"t": 0.0}
+    wd = WatchDog(Settings(stale_after_s=10, min_shards=1, min_sources=0),
+                  clock=lambda: clk["t"])
+    sid = wd.join("shard")
+    clk["t"] = 11.0
+    wd.stale()
+    wd.beat("shard", sid)            # recovery ends the episode
+    assert _gauge("raphtory_cluster_stale_members") == 0
+    clk["t"] = 22.5
+    wd.stale()                       # a SECOND episode emits again
+    assert sum(1 for e in TRACER.recent(100)
+               if e["name"] == "cluster.stale") == 2
+
+
+def test_watchdog_await_up_with_injected_clock():
+    clk = {"t": 0.0}
+    wd = WatchDog(Settings(min_shards=2, min_sources=0),
+                  clock=lambda: clk["t"])
+    wd.join("shard")
+    assert not wd.await_up(timeout_s=0.1, poll_s=0.01)
+    wd.join("shard")
+    assert wd.await_up(timeout_s=0.1, poll_s=0.01)
+
+
+def test_watchdog_status_snapshot():
+    clk = {"t": 0.0}
+    wd = WatchDog(Settings(stale_after_s=30, auto_down_after_s=100,
+                           min_shards=1, min_sources=1),
+                  clock=lambda: clk["t"])
+    wd.join("shard")
+    wd.join("shard")
+    wd.join("source")
+    clk["t"] = 20.0
+    wd.beat("shard", 0)              # shard 1 + source 0 go quiet
+    clk["t"] = 45.0
+    st = wd.status()
+    assert st["members"] == {"shard": [0, 1], "source": [0]}
+    assert ["shard", 1, 45.0] in st["stale"]
+    assert ["source", 0, 45.0] in st["stale"]
+    assert st["down"] == [] and st["cluster_up"]
+    wd.beat("shard", 0)              # shard 0 stays fresh
+    clk["t"] = 121.0                 # shard 1/source 0 past auto-down
+    wd.auto_down()
+    st = wd.status()
+    assert st["members"] == {"shard": [0]}
+    assert ["shard", 1] in st["down"]
+    assert not st["cluster_up"]      # no live source → gate drops
+
+
+# ---- watermark lag ----
+
+def test_watermark_lag_seconds():
+    from raphtory_tpu.ingestion.watermark import WatermarkRegistry
+
+    wm = WatermarkRegistry()
+    assert wm.lag_seconds() == 0.0           # nothing streaming
+    wm.register("s")
+    wm.advance("s", 100)
+    assert wm.lag_seconds() < 5.0            # just advanced
+    wm._advanced_at -= 42.0                  # simulate a stalled fence
+    assert wm.lag_seconds() > 40.0
+    wm.finish("s")                           # exhausted: can't stall
+    assert wm.lag_seconds() == 0.0
+    # the pull-time gauge reads through the same callable
+    assert _gauge("raphtory_watermark_lag_seconds") == 0.0
+
+
+# ---- peer discovery ----
+
+def test_resolve_peers_derived_from_striding(monkeypatch):
+    monkeypatch.delenv("RTPU_CLUSTER_PEERS", raising=False)
+    monkeypatch.delenv("RTPU_PORT_STRIDE", raising=False)
+    monkeypatch.delenv("RTPU_PEER_HOST", raising=False)
+    assert resolve_peers(2, 8081) == (
+        "http://127.0.0.1:8081", "http://127.0.0.1:8082")
+
+
+def test_resolve_peers_static_env(monkeypatch):
+    monkeypatch.setenv("RTPU_CLUSTER_PEERS",
+                       "10.0.0.1:8081, http://10.0.0.2:9000/")
+    assert resolve_peers(5) == (
+        "http://10.0.0.1:8081", "http://10.0.0.2:9000")
+
+
+def test_resolve_peers_static_file(monkeypatch, tmp_path):
+    f = tmp_path / "peers.txt"
+    f.write_text("# the mesh\n10.0.0.1:8081\n\n10.0.0.2:8081\n")
+    monkeypatch.setenv("RTPU_CLUSTER_PEERS", f"@{f}")
+    assert resolve_peers(1) == (
+        "http://10.0.0.1:8081", "http://10.0.0.2:8081")
+    monkeypatch.setenv("RTPU_CLUSTER_PEERS", "@/nonexistent/peers.txt")
+    assert resolve_peers(1, 8081) == ("http://127.0.0.1:8081",)
+
+
+# ---- scraper ----
+
+def test_peer_scraper_dead_peer_is_data_not_error():
+    s = PeerScraper(timeout_s=0.3)
+    out = s.scrape(["http://127.0.0.1:9"])   # discard port: refused
+    row = out["http://127.0.0.1:9"]
+    assert row["reachable"] is False and row["error"]
+
+
+def test_peer_scraper_cache_bounded_and_ttl():
+    s = PeerScraper(timeout_s=0.1, ttl_s=60.0)
+    # failures are never cached
+    s.scrape(["http://127.0.0.1:9"])
+    assert s._cache == {}
+    # bounded: evicts oldest past the cap
+    s._store({f"http://p{i}": {"reachable": True} for i in range(200)})
+    assert len(s._cache) <= 64
+    # fresh snapshots are served from cache (no network for a cached url)
+    s._store({"http://cached": {"reachable": True, "marker": 1}})
+    out = s.scrape(["http://cached"])
+    assert out["http://cached"]["marker"] == 1
+
+
+# ---- /clusterz federation e2e (single process, self + dead peer) ----
+
+@pytest.fixture
+def rest_node():
+    from raphtory_tpu.core.service import TemporalGraph
+    from raphtory_tpu.ingestion.pipeline import IngestionPipeline
+    from raphtory_tpu.ingestion.source import IterableSource
+    from raphtory_tpu.ingestion.updates import EdgeAdd
+    from raphtory_tpu.jobs.manager import AnalysisManager
+    from raphtory_tpu.jobs.rest import RestServer
+
+    pipe = IngestionPipeline()
+    pipe.add_source(IterableSource(
+        [EdgeAdd(t, t % 8, (t + 1) % 8) for t in range(60)], name="t"))
+    pipe.run()
+    g = TemporalGraph(pipe.log, pipe.watermarks)
+    mgr = AnalysisManager(g)
+    srv = RestServer(mgr, port=0).start()
+    try:
+        yield g, mgr, srv
+    finally:
+        srv.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def test_statusz_has_cluster_block(rest_node):
+    g, mgr, srv = rest_node
+    st = _get(srv.port, "/statusz")
+    assert st["cluster"]["process_index"] == 0
+    assert st["cluster"]["ports"]["rest"] == srv.port
+    assert "collectives" in st
+    assert "lag_seconds" in st["watermark"]
+
+
+def test_clusterz_merges_self_and_renders_dead_peer(rest_node,
+                                                    monkeypatch):
+    g, mgr, srv = rest_node
+    monkeypatch.setenv("RTPU_CLUSTER_PEERS",
+                       f"127.0.0.1:{srv.port},127.0.0.1:9")
+    monkeypatch.setenv("RTPU_CLUSTERZ_TIMEOUT", "0.3")
+    SCRAPER.clear()
+    cz = _get(srv.port, "/clusterz")
+    assert cz["peers_configured"] == 2
+    me = cz["processes"]["process_0"]
+    assert me["reachable"] and me.get("self")
+    assert me["ports"]["rest"] == srv.port
+    dead = cz["processes"]["http://127.0.0.1:9"]
+    assert dead["reachable"] is False        # unreachable, never a 500
+    assert cz["processes_reachable"] == 1
+
+
+def test_clusterz_static_same_port_mesh_scrapes_every_host(rest_node,
+                                                           monkeypatch):
+    """Review regression: a real multi-host static peer list binds the
+    SAME port on every host — self-identification by port alone
+    classified every peer as self and federation never scraped anyone.
+    Self is loopback-host + port; same-port foreign hosts are peers."""
+    g, mgr, srv = rest_node
+    monkeypatch.setenv(
+        "RTPU_CLUSTER_PEERS",
+        f"127.0.0.1:{srv.port},10.255.0.1:{srv.port},10.255.0.2:{srv.port}")
+    monkeypatch.setenv("RTPU_CLUSTERZ_TIMEOUT", "0.3")
+    SCRAPER.clear()
+    cz = _get(srv.port, "/clusterz")
+    assert cz["peers_configured"] == 3
+    # both same-port foreign hosts were SCRAPED (they render unreachable
+    # here — the point is they are not silently dropped as self)
+    foreign = [p for p in cz["processes"].values() if p.get("url")]
+    assert {p["url"] for p in foreign} == {
+        f"http://10.255.0.1:{srv.port}", f"http://10.255.0.2:{srv.port}"}
+    assert cz["processes"]["process_0"].get("self")
+
+
+def test_clusterz_surfaces_unreadable_peer_file(rest_node, monkeypatch):
+    monkeypatch.setenv("RTPU_CLUSTER_PEERS", "@/nonexistent/peers.txt")
+    g, mgr, srv = rest_node
+    SCRAPER.clear()
+    cz = _get(srv.port, "/clusterz")
+    assert "/nonexistent/peers.txt" in cz.get("peers_error", "")
+
+
+def test_clusterz_cross_trace_reassembly(rest_node, traced, monkeypatch):
+    g, mgr, srv = rest_node
+    monkeypatch.setenv("RTPU_CLUSTER_PEERS", f"127.0.0.1:{srv.port}")
+    SCRAPER.clear()
+    body = json.dumps({"analyserName": "DegreeBasic",
+                       "timestamp": 59}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/ViewAnalysisRequest", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        sub = json.loads(r.read().decode())
+    tid = sub["traceID"]
+    assert tid
+    mgr.get(sub["jobID"]).wait(60)
+    cz = _get(srv.port, f"/clusterz?trace_id={tid}")
+    tr = cz["trace"]
+    assert tr["trace_id"] == tid and tr["span_count"] > 0
+    assert "process_0" in tr["processes_with_spans"]
+
+
+def test_post_adopts_wire_trace_context(rest_node, traced):
+    """A forwarded POST (X-RTPU-Trace) must JOIN the originating trace:
+    the job's spans carry the wire trace id, origin process intact."""
+    g, mgr, srv = rest_node
+    ctx = TraceContext("remote-proc-trace-9", 7, origin=1)
+    body = json.dumps({"analyserName": "DegreeBasic",
+                       "timestamp": 59}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/ViewAnalysisRequest", data=body,
+        headers={"Content-Type": "application/json",
+                 TraceContext.HEADER: ctx.to_wire()})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        sub = json.loads(r.read().decode())
+    assert sub["traceID"] == "remote-proc-trace-9"
+    job = mgr.get(sub["jobID"])
+    assert job.wait(60) and job.status == "done", job.error
+    assert job.trace_id == "remote-proc-trace-9"
+    spans = TRACER.for_trace("remote-proc-trace-9")
+    assert any(s["name"] == "rest.request" for s in spans)
+    assert any(s["name"] == "job" for s in spans)
+
+
+def test_get_scrape_header_joins_trace(rest_node, traced):
+    g, mgr, srv = rest_node
+    ctx = TraceContext("scrape-trace-1", 3, origin=1)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/statusz",
+        headers={TraceContext.HEADER: ctx.to_wire()})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    spans = TRACER.for_trace("scrape-trace-1")
+    assert any(s["name"] == "rest.serve_scrape" for s in spans)
+
+
+def test_ledger_dcn_block_roundtrip():
+    from raphtory_tpu.obs.ledger import Ledger
+
+    led = Ledger("q", "pagerank")
+    led.add_dcn("halo", rows=10, bytes_=100)
+    led.add_dcn("halo", rows=5, bytes_=50)
+    led.add_dcn("all_gather", rows=1, bytes_=8)
+    d = led.as_dict()["dcn"]
+    assert d["bytes"] == 158 and d["rows"] == 16
+    assert d["routes"]["halo"]["dispatches"] == 2
+    # merge folds sub-ledger dcn in
+    other = Ledger()
+    other.add_dcn("halo", rows=1, bytes_=2)
+    led.merge(other)
+    assert led.as_dict()["dcn"]["routes"]["halo"]["bytes"] == 152

@@ -597,6 +597,48 @@ def test_blocking_under_lock_through_init_reexport():
     assert "pkg.refresh" in rt9[0].message
 
 
+def test_socket_io_under_lock_in_scrape_loop_flagged():
+    # the /clusterz peer-scrape shape (obs/cluster.py): holding the
+    # snapshot-cache lock across the HTTP fan-out serializes every
+    # scraper behind the slowest peer's socket timeout
+    fs = lint("""
+        import threading
+        import urllib.request
+
+        _CACHE_LOCK = threading.Lock()
+        _CACHE = {}
+
+        def scrape(urls):
+            with _CACHE_LOCK:
+                for u in urls:
+                    with urllib.request.urlopen(u, timeout=2.0) as r:
+                        _CACHE[u] = r.read()
+    """)
+    assert rules_of(fs) == ["blocking-call-under-lock"]
+    assert "urlopen" in fs[0].message and "_CACHE_LOCK" in fs[0].message
+
+
+def test_socket_io_outside_lock_scrape_loop_clean():
+    # the clean idiom obs/cluster.PeerScraper uses: the network fan-out
+    # completes lock-free; the lock only ever guards dict ops
+    fs = lint("""
+        import threading
+        import urllib.request
+
+        _CACHE_LOCK = threading.Lock()
+        _CACHE = {}
+
+        def scrape(urls):
+            fetched = {}
+            for u in urls:
+                with urllib.request.urlopen(u, timeout=2.0) as r:
+                    fetched[u] = r.read()
+            with _CACHE_LOCK:
+                _CACHE.update(fetched)
+    """)
+    assert "blocking-call-under-lock" not in rules_of(fs)
+
+
 def test_blocking_under_lock_suppressed():
     fs = lint(RT009_POSITIVE.replace(
         "time.sleep(1.0)",

@@ -1,0 +1,371 @@
+#!/usr/bin/env python3
+"""2-process localhost cluster smoke + observability-overhead bench.
+
+Driver (default mode) spawns TWO worker processes that form a real
+`jax.distributed` cluster on localhost (CPU backend, 2 local devices
+each), each serving REST on a port-strided listener. The smoke then
+proves the ISSUE-10 acceptance path end to end:
+
+* worker 0 submits a sharded sweep to ITSELF, forwards the SAME request
+  to worker 1 with the ``X-RTPU-Trace`` header — one REST-initiated
+  sweep, ONE trace id across both processes;
+* ``/tracez?trace_id=`` on the origin process shows the local half;
+* ``/clusterz`` on worker 0 must show BOTH members reachable, watchdog
+  membership, per-process watermark lag, nonzero per-route collective
+  bytes, per-shard halo skew, and barrier-wait fields;
+* ``/clusterz?trace_id=`` must reassemble the trace with spans from
+  BOTH processes.
+
+The federated snapshot is written to ``--out`` (the CI failure
+artifact). Exit 0 prints CLUSTERZ_OK; any assertion prints the evidence
+and exits 1. A jax whose CPU client cannot even form the distributed
+handshake exits 0 with SKIPPED (the capability under test is the
+observability plane, not the collectives — each process sweeps its own
+LOCAL 2-device mesh, so cross-process device collectives are not
+required; on jaxes that lack them the smoke still proves everything).
+
+``--pairs N`` adds the ``multichip_obs_overhead`` measurement on worker
+0: N interleaved telemetry-off/on pairs of a jobs-layer sharded range
+sweep (median per-pair ratio — the shared-box protocol), with worker 1
+alive and serving its REST plane throughout so the federation surface is
+real. bench.py wraps this mode as ``--config multichip_obs_overhead``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SKIP_MARKERS = (
+    "Multiprocess computations aren't implemented on the CPU backend",
+    "distributed initialization failed",
+)
+
+
+# ----------------------------------------------------------------- worker
+
+def _http_json(url, body=None, headers=None, timeout=30.0):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, headers=headers or {})
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _wait_http(url, timeout_s=90.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            return _http_json(url, timeout=5.0)
+        except OSError:   # URLError/refused/timeout: server still coming up
+            time.sleep(0.25)
+    raise TimeoutError(f"no answer from {url} within {timeout_s}s")
+
+
+def _wait_done(base, job_id, timeout_s=300.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        r = _http_json(f"{base}/AnalysisResults?jobID={job_id}",
+                       timeout=10.0)
+        if r["status"] in ("done", "failed", "killed"):
+            if r["status"] != "done":
+                raise RuntimeError(f"job {job_id}: {r['status']} "
+                                   f"{r.get('error')}")
+            return r
+        time.sleep(0.2)
+    raise TimeoutError(f"job {job_id} not done in {timeout_s}s")
+
+
+def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
+           pairs: int, cheap: bool, out: str | None) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2")
+
+    import numpy as np
+
+    from raphtory_tpu.cluster.bootstrap import bootstrap
+    from raphtory_tpu.cluster.watchdog import WatchDog
+    from raphtory_tpu.core.service import TemporalGraph
+    from raphtory_tpu.ingestion.pipeline import IngestionPipeline
+    from raphtory_tpu.ingestion.source import IterableSource
+    from raphtory_tpu.ingestion.updates import EdgeAdd
+    from raphtory_tpu.jobs.manager import AnalysisManager
+    from raphtory_tpu.jobs.rest import RestServer
+    from raphtory_tpu.obs.trace import TRACER, TraceContext
+    from raphtory_tpu.parallel import sharded
+
+    assert bootstrap(coordinator_address=f"127.0.0.1:{coord_port}",
+                     num_processes=2, process_id=idx)
+    assert TRACER.process_index == idx
+
+    # identical synthetic stream on both processes (the reference's
+    # data-replicated ingestion); a LIVE unfinished source keeps the
+    # watermark fence meaningful so lag_seconds is a real signal
+    n_ev = 50_000 if cheap else 120_000
+    n_vert = 2048 if cheap else 4096
+    rng = np.random.default_rng(7)
+    ups = [EdgeAdd(int(t), int(a), int(b))
+           for t, a, b in zip(np.sort(rng.integers(0, 1000, n_ev)),
+                              rng.integers(0, n_vert, n_ev),
+                              rng.integers(0, n_vert, n_ev))]
+    pipe = IngestionPipeline()
+    pipe.add_source(IterableSource(ups, name="smoke"))
+    pipe.run()
+    graph = TemporalGraph(pipe.log, pipe.watermarks)
+
+    # each process sweeps its own LOCAL 2-device mesh: the halo /
+    # all_gather collective routes (and their telemetry) run on every
+    # jax; cross-process reassembly happens at the REST layer
+    mesh = sharded.make_mesh(2, 1,
+                             devices=np.asarray(jax.local_devices()))
+    wd = WatchDog()
+    wd.join("shard")
+    wd.join("job-server")
+    mgr = AnalysisManager(graph, mesh=mesh)
+    srv = RestServer(mgr, port=rest_base, watchdog=wd).start()
+    me = f"http://127.0.0.1:{srv.port}"
+    peer = f"http://127.0.0.1:{rest_base + (1 - idx)}"
+    print(f"worker {idx} rest on {srv.port}", flush=True)
+
+    _wait_http(f"{me}/healthz")
+    _wait_http(f"{peer}/healthz")
+    sentinel = os.path.join(tmpdir, "driver_done")
+
+    if idx == 1:
+        # serve until worker 0 finishes its assertions
+        deadline = time.monotonic() + 600
+        while not os.path.exists(sentinel):
+            if time.monotonic() > deadline:
+                raise TimeoutError("no driver_done sentinel")
+            time.sleep(0.25)
+        srv.stop()
+        print("worker 1 ok", flush=True)
+        return
+
+    # ---- worker 0: the REST-initiated cross-process sweep ----
+    latest = int(graph.latest_time)
+    body = {"analyserName": "PageRank", "timestamp": latest,
+            "windowType": "batched", "windowSet": [800, 200],
+            "params": {"max_steps": 10, "tol": 0.0}}
+    sub0 = _http_json(f"{me}/ViewAnalysisRequest", body)
+    tid = sub0.get("traceID")
+    assert tid, f"no traceID in submit response: {sub0}"
+    # forward the hop: the SAME trace id crosses the process boundary
+    wire = TraceContext(tid, 0, origin=idx).to_wire()
+    sub1 = _http_json(f"{peer}/ViewAnalysisRequest", body,
+                      headers={TraceContext.HEADER: wire})
+    assert sub1.get("traceID") == tid, (
+        f"peer opened its own trace: {sub1} != {tid}")
+    _wait_done(me, sub0["jobID"])
+    _wait_done(peer, sub1["jobID"])
+
+    # ---- collect the evidence FIRST (the CI failure artifact must
+    # show what the cluster looked like even when an assertion fires)
+    tz = _http_json(f"{me}/tracez?trace_id={tid}")
+    cz = _http_json(f"{me}/clusterz?refresh=1")
+    czt = _http_json(f"{me}/clusterz?trace_id={tid}&refresh=1")
+    if out:
+        with open(out, "w") as f:
+            json.dump({"clusterz": cz, "trace": czt["trace"],
+                       "trace_id": tid}, f, indent=1, default=str)
+
+    # ---- acceptance assertions ----
+    assert tz["spans"], "origin /tracez?trace_id= has no spans"
+    assert any(s["name"] == "comm.exchange" for s in tz["spans"]), \
+        "no comm.exchange span in the origin trace"
+    procs = cz["processes"]
+    assert cz["processes_reachable"] == 2, procs
+    assert {p.get("process_index") for p in procs.values()} == {0, 1}, procs
+    shard_members = cz["members"].get("shard", {})
+    assert shard_members.get("count") == 2, cz["members"]
+    for name, p in procs.items():
+        routes = p["collectives"]["routes"]
+        assert routes and any(r["bytes"] > 0 for r in routes.values()), \
+            f"{name}: no collective bytes: {routes}"
+        skew = p["collectives"]["skew"]
+        assert skew and "halo_dst" in skew and "edges_dst" in skew, \
+            f"{name}: no halo/degree skew: {skew}"
+        assert "barrier_wait_seconds" in p["collectives"], name
+        assert p.get("watermark_lag_seconds") is not None, name
+        assert "queue_depth" in p, name
+
+    with_spans = czt["trace"]["processes_with_spans"]
+    assert set(with_spans) >= {"process_0", "process_1"}, (
+        f"trace {tid} not reassembled from both processes: {with_spans}")
+
+    # ---- optional bench mode: interleaved telemetry off/on pairs ----
+    if pairs > 0:
+        from raphtory_tpu.jobs.manager import RangeQuery
+
+        n_hops = 12 if cheap else 16
+        times = np.linspace(0.4 * latest, latest, n_hops).astype(np.int64)
+        q = RangeQuery(int(times[0]), int(times[-1]),
+                       int(times[1] - times[0]) or 1,
+                       windows=(800, 400, 200, 100))
+        from raphtory_tpu.jobs import registry
+
+        def once():
+            # the timed unit is a multi-second sharded range job: per-pair
+            # ratio cancellation only works when the unit outlasts the
+            # shared box's drift bursts (sub-second units read pure noise)
+            t0 = time.perf_counter()
+            job = mgr.submit(registry.resolve(
+                "PageRank", {"max_steps": 25, "tol": 0.0}), q)
+            ok = job.wait(600)
+            dt = time.perf_counter() - t0
+            if not ok or job.status != "done":
+                raise RuntimeError(f"bench job {job.status}: {job.error}")
+            return dt
+
+        def arm(on: bool):
+            os.environ["RTPU_SLO"] = "1" if on else "0"
+            os.environ["RTPU_LEDGER"] = "1" if on else "0"
+            (TRACER.enable if on else TRACER.disable)()
+
+        arm(True)
+        once()                         # warm: compiles + caches, untimed
+        ab = []
+        for i in range(pairs):
+            # ABBA: alternate which arm leads — a monotonic drift across
+            # the run then biases half the pairs each way instead of
+            # reading uniformly as overhead
+            order = (False, True) if i % 2 == 0 else (True, False)
+            t = {}
+            for on in order:
+                arm(on)
+                t[on] = once()
+            ab.append((t[False], t[True]))
+        arm(True)
+        t0 = time.perf_counter()
+        _http_json(f"{me}/clusterz?refresh=1")
+        scrape_s = time.perf_counter() - t0
+        print("BENCH_PAIRS " + json.dumps(
+            {"pairs": ab, "clusterz_scrape_seconds": round(scrape_s, 4),
+             "n_views": n_hops * 4}), flush=True)
+
+    with open(sentinel, "w") as f:
+        f.write("ok")
+    srv.stop()
+    print("CLUSTERZ_OK", flush=True)
+
+
+# ----------------------------------------------------------------- driver
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _free_port_pair() -> int:
+    """A base port with base+1 also free (the strided REST pair)."""
+    for _ in range(64):
+        base = _free_port()
+        try:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", base + 1))
+            return base
+        except OSError:
+            continue
+    raise RuntimeError("no free adjacent port pair")
+
+
+def run_cluster(out: str | None = None, pairs: int = 0,
+                cheap: bool = False, timeout_s: float = 600.0) -> dict:
+    """Spawn the 2-worker cluster; returns {skipped, outputs, pairs...}.
+    Raises on real failures (assertions inside a worker, timeouts)."""
+    coord = _free_port()
+    rest_base = _free_port_pair()
+    tmpdir = tempfile.mkdtemp(prefix="rtpu_cluster_smoke_")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)     # workers pin their own backend
+    env.pop("XLA_FLAGS", None)
+    env["RTPU_TRACE"] = "1"
+    # forced, not setdefault: the worker's peer-URL math is rest_base +
+    # (1 - idx), i.e. stride 1 — an inherited RTPU_PORT_STRIDE=2 would
+    # bind worker 1 two ports up and the smoke would poll a dead port
+    env["RTPU_PORT_STRIDE"] = "1"
+    env.pop("RTPU_CLUSTER_PEERS", None)   # derive from the topology
+    procs = []
+    for i in (0, 1):
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--worker", str(i), "--coord-port", str(coord),
+               "--rest-base", str(rest_base), "--tmpdir", tmpdir,
+               "--pairs", str(pairs)]
+        if cheap:
+            cmd.append("--cheap")
+        if out and i == 0:
+            cmd += ["--out", out]
+        procs.append(subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = ["", ""]
+    try:
+        for i, p in enumerate(procs):
+            outs[i], _ = p.communicate(timeout=timeout_s)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if any(m in o for o in outs for m in _SKIP_MARKERS):
+        return {"skipped": True, "outputs": outs}
+    for i, (p, o) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"worker {i} failed (rc={p.returncode}):\n{o[-4000:]}")
+    if "CLUSTERZ_OK" not in outs[0]:
+        raise RuntimeError(f"worker 0 missing CLUSTERZ_OK:\n"
+                           f"{outs[0][-4000:]}")
+    res: dict = {"skipped": False, "outputs": outs}
+    for line in outs[0].splitlines():
+        if line.startswith("BENCH_PAIRS "):
+            res.update(json.loads(line[len("BENCH_PAIRS "):]))
+    return res
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--worker", type=int, default=None)
+    ap.add_argument("--coord-port", type=int, default=0)
+    ap.add_argument("--rest-base", type=int, default=0)
+    ap.add_argument("--tmpdir", default="")
+    ap.add_argument("--pairs", type=int, default=0,
+                    help="bench mode: N interleaved off/on pairs")
+    ap.add_argument("--cheap", action="store_true")
+    ap.add_argument("--out", default=None,
+                    help="write the federated snapshot JSON here")
+    args = ap.parse_args(argv)
+    if args.worker is not None:
+        worker(args.worker, args.coord_port, args.rest_base, args.tmpdir,
+               args.pairs, args.cheap, args.out)
+        return 0
+    res = run_cluster(out=args.out, pairs=args.pairs, cheap=args.cheap)
+    if res["skipped"]:
+        print("SKIPPED: this jax cannot form a localhost "
+              "jax.distributed cluster")
+        return 0
+    print("cluster smoke ok" + (
+        f"; pairs={res['pairs']}" if args.pairs else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
