@@ -1560,6 +1560,155 @@ def bench_telemetry_overhead():
     }
 
 
+def bench_advisor_overhead():
+    """Judgment-plane overhead on the serving path — the PR-11 proof row
+    (acceptance: <= 5% with attribution + budgets + advisor all on).
+
+    The on-arm runs with per-tenant workload attribution (every job
+    submitted under a cycling tenant identity, its closed ledger merged
+    into the account — obs/workload.py), an `RTPU_SLO_TARGET` error
+    budget evaluated against the live histograms, AND the periodic
+    advisor thread ticking every 1 s — 30x the production default, so
+    several full rule passes land inside every timed multi-second job
+    (obs/advisor.py) — the configuration a production
+    server would run ON TOP of the PR-9 telemetry baseline, which stays
+    at its defaults in BOTH arms so the row isolates the judgment
+    layer's own cost. Off = all three off. Interleaved ABBA pairs,
+    judged on the MEDIAN per-pair ratio (the shared-box protocol). The
+    healthy-run advisor finding count and the /advisez + /workloadz
+    snapshots ride in the detail — CI asserts ZERO findings on this
+    healthy shape and uploads the snapshots on failure.
+    RTPU_BENCH_CHEAP=1 shrinks the shape for CI
+    (`advisor_overhead_cheap`, its own perfwatch series)."""
+    import statistics
+
+    from raphtory_tpu.algorithms import PageRank
+    from raphtory_tpu.core.service import TemporalGraph
+    from raphtory_tpu.jobs.manager import AnalysisManager, RangeQuery
+    from raphtory_tpu.obs.advisor import ADVISOR
+    from raphtory_tpu.obs.workload import WORKLOAD
+    from raphtory_tpu.utils.synth import gab_like_log
+
+    cheap = os.environ.get("RTPU_BENCH_CHEAP", "0") not in ("", "0")
+    if cheap:
+        log = gab_like_log(n_vertices=8_000, n_edges=80_000,
+                           t_span=_GAB_SPAN)
+        n_hops, pairs = 8, 5
+    else:
+        log = _gab_log()
+        # 5 pairs (not the telemetry row's 3): the judgment plane's
+        # expected cost is small, so per-pair ratio cancellation needs
+        # more pairs before the shared box's drift stops dominating
+        n_hops, pairs = 12, 5
+    view_times = np.linspace(0.45 * _GAB_SPAN, _GAB_SPAN,
+                             n_hops).astype(np.int64)
+    windows = [2_600_000, 604_800, 86_400]
+    q = RangeQuery(int(view_times[0]), int(view_times[-1]),
+                   int(view_times[1] - view_times[0]) or 1,
+                   windows=tuple(windows))
+    graph = TemporalGraph(log)
+    mgr = AnalysisManager(graph)
+    knobs = ("RTPU_WORKLOAD", "RTPU_ADVISOR", "RTPU_ADVISOR_INTERVAL_S",
+             "RTPU_SLO_TARGET")
+    saved = {k: os.environ.get(k) for k in knobs}
+
+    def arm(on: bool):
+        os.environ["RTPU_WORKLOAD"] = "1" if on else "0"
+        os.environ["RTPU_ADVISOR"] = "1" if on else "0"
+        # a target the healthy run can never burn: the budget math runs
+        # (collectors, windows, grades) without manufacturing findings
+        os.environ["RTPU_SLO_TARGET"] = \
+            "pagerank=p99:60s" if on else ""
+
+    tenants = ("acme", "zeta", "ops", "batch")
+    seq = [0]
+
+    def once():
+        # the tenant rides in BOTH arms (normalization is part of the
+        # submit path either way); RTPU_WORKLOAD gates the accounting
+        seq[0] += 1
+        t0 = _time.perf_counter()
+        job = mgr.submit(PageRank(tol=1e-7, max_steps=20), q,
+                         tenant=tenants[seq[0] % len(tenants)])
+        ok = job.wait(600)
+        dt = _time.perf_counter() - t0
+        if not ok or job.status != "done":
+            raise RuntimeError(f"bench job {job.status}: {job.error}")
+        return dt
+
+    WORKLOAD.clear()
+    ADVISOR.clear()
+    os.environ["RTPU_ADVISOR_INTERVAL_S"] = "1.0"
+    try:
+        arm(True)
+        ADVISOR.start()
+        once()           # warm: compiles + fold cache + harvest, untimed
+        ab = []
+        for i in range(pairs):   # interleaved ABBA off/on pairs
+            order = (False, True) if i % 2 == 0 else (True, False)
+            t = {}
+            for on in order:
+                arm(on)
+                t[on] = once()
+            ab.append((t[False], t[True]))
+        arm(True)
+        # ONE pass supplies both the healthy-run gate and the uploaded
+        # artifact — a rule flapping between two separate ticks must not
+        # fail CI with an artifact that shows zero findings
+        advisez = ADVISOR.advisez()
+        findings = advisez["findings"]
+        workloadz = WORKLOAD.workloadz()
+        ticks = ADVISOR.ticks
+    finally:
+        ADVISOR.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    ratios = sorted(on / off for off, on in ab)
+    median = statistics.median(ratios)
+    off_min = min(off for off, _ in ab)
+    on_min = min(on for _, on in ab)
+    return {
+        "config": ("advisor_overhead_cheap" if cheap
+                   else "advisor_overhead"),
+        "metric": ("judgment-plane overhead on the jobs path (tenant "
+                   "attribution + error budgets + 1s advisor ticks "
+                   "on vs all off, "
+                   + ("CI cheap shape)" if cheap
+                      else "GAB-scale windowed-PageRank range job)")),
+        "value": round((median - 1.0) * 100.0, 2),
+        "unit": "percent_slower_with_advisor_plane",
+        "detail": {
+            "n_views": n_hops * len(windows),
+            "engine": "jobs_manager_range (hopbatch columnar route)",
+            "cheap_mode": cheap,
+            "timing": ("interleaved_ABBA_pairs_median_ratio_warm_fold_"
+                       "cache — per-pair off/on ratios with alternating "
+                       "arm order cancel shared-box drift; baseline "
+                       "telemetry (SLO/ledger defaults) identical in "
+                       "both arms"),
+            "pairs": [[round(a, 4), round(b, 4)] for a, b in ab],
+            "per_pair_overhead_pct": [round((r - 1) * 100, 2)
+                                      for r in ratios],
+            "min_vs_min_overhead_pct": round(
+                (on_min / off_min - 1.0) * 100.0, 2),
+            "advisor_off_seconds": round(off_min, 4),
+            "advisor_on_seconds": round(on_min, 4),
+            "advisor_ticks": int(ticks),
+            # CI gates on this: a healthy run must emit ZERO findings
+            "advisor_findings_healthy": len(findings),
+            "advisez": advisez,
+            "workloadz": workloadz,
+            "acceptance": ("on/off regression must stay <= 5%; "
+                           "advisor_findings_healthy must be 0"),
+            "baseline": "the all-off column of this same row",
+        },
+    }
+
+
 def bench_sanitize_probe():
     """ONE arm of the sanitize_overhead A/B, meant to run in a SUBPROCESS
     with RTPU_SANITIZE pinned in the environment: the sanitizer installs
@@ -1983,6 +2132,7 @@ CONFIGS = {
     "transfer_pipeline": bench_transfer_pipeline,
     "trace_overhead": bench_trace_overhead,
     "telemetry_overhead": bench_telemetry_overhead,
+    "advisor_overhead": bench_advisor_overhead,
     # 2-process localhost cluster A/B: spawns its own subprocess pair,
     # excluded from --suite (underscore-free but cluster-shaped) — run
     # it explicitly: bench.py --config multichip_obs_overhead

@@ -23,8 +23,10 @@ from ..analysis.sanitizer import (note_shared as _san_note,
 from ..core.service import TemporalGraph
 from ..engine import bsp
 from ..engine.program import VertexProgram
+from ..obs import advisor as _advisor
 from ..obs import ledger as _ledger
 from ..obs import slo as _slo
+from ..obs import workload as _workload
 from ..obs.metrics import METRICS
 from ..obs.trace import TRACER, block_steps as _block_steps
 
@@ -76,7 +78,7 @@ Query = ViewQuery | RangeQuery | LiveQuery
 class Job:
     def __init__(self, job_id: str, program: VertexProgram, query: Query,
                  graph: TemporalGraph, mesh=None, wait_timeout: float = 30.0,
-                 explain: bool = False):
+                 explain: bool = False, tenant: str | None = None):
         self.id = job_id
         self.program = program
         self.query = query
@@ -89,6 +91,11 @@ class Job:
         self.explain = bool(explain)
         self.ledger = _ledger.Ledger(
             job_id, getattr(program, "cost_label", type(program).__name__))
+        #: normalized tenant identity (obs/workload.py): the account this
+        #: job's closed ledger rolls into. Normalization NEVER raises —
+        #: a malformed tenant header must not fail the request it rode
+        self.tenant = _workload.normalize_tenant(tenant)
+        self.ledger.tenant = self.tenant
         # trace-context handoff: a Job is constructed on the SUBMITTING
         # thread (the REST handler's rest.request span is still open),
         # and the job thread adopts this context in _run — so one REST
@@ -204,6 +211,18 @@ class Job:
                 _slo.SLO.observe(alg, ph, sec, trace_id=self.trace_id)
         # queue wait is an ADMISSION signal, valid whatever the outcome
         METRICS.job_queue_wait_seconds.observe(led.queue_wait_seconds)
+        # per-tenant workload account (obs/workload.py): its own knob
+        # (RTPU_WORKLOAD), independent of RTPU_LEDGER — the jobs-layer
+        # phase timings above are collected either way, and attribution
+        # must survive turning the engine-side cost harvest off
+        _workload.WORKLOAD.record(led, status=self.status)
+        # advisor evidence ring (obs/advisor.py): jobs-layer data that,
+        # like the SLO and workload surfaces above, must survive
+        # RTPU_LEDGER=0 — otherwise every query-windowed rule silently
+        # goes inert in a supported config. Gated on the advisor's own
+        # knob so the bench off-arm pays nothing.
+        if _advisor.enabled():
+            _advisor.note_query(led.as_dict())
         if not _ledger.collection_enabled():
             return
         METRICS.query_cost_queries.labels(alg, led.bound()).inc()
@@ -822,7 +841,7 @@ class AnalysisManager:
                job_id: str | None = None, mesh=None,
                wait_timeout: float = 30.0, sink_name: str | None = None,
                sink_format: str | None = None,
-               explain: bool = False) -> Job:
+               explain: bool = False, tenant: str | None = None) -> Job:
         from .sink import ResultSink, resolve_sink_path
 
         with self._lock:
@@ -832,7 +851,8 @@ class AnalysisManager:
                 raise KeyError(f"job {job_id!r} already exists")
             job = Job(job_id, program, query, self.graph,
                       mesh=mesh if mesh is not None else self.mesh,
-                      wait_timeout=wait_timeout, explain=explain)
+                      wait_timeout=wait_timeout, explain=explain,
+                      tenant=tenant)
             self._jobs[job_id] = job
             self._note_table(write=True)
             self._evict_done_locked()

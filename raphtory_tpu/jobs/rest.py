@@ -19,11 +19,16 @@ ledger: per-kernel XLA cost/memory analysis with roofline classification
 plus recent per-query ledgers — docs/OBSERVABILITY.md "Cost ledger"),
 ``/slz`` (per-algorithm SLO latency histograms whose tail buckets carry
 trace-ID exemplars, plus the bounded queue-depth/stall series ring with
-text sparklines — obs/slo.py), and ``/profilez`` (the continuous
+text sparklines — obs/slo.py), ``/profilez`` (the continuous
 sampling profiler: JSON status, ``?format=collapsed`` flamegraph lines,
-``?enable=0|1`` — obs/sampler.py). POST bodies additionally accept
-``explain`` (truthy): the job's resource ledger rides back with
-``/AnalysisResults``.
+``?enable=0|1`` — obs/sampler.py), ``/workloadz`` (per-tenant workload
+accounts rolled up from the query ledgers — obs/workload.py; POSTs may
+carry an ``X-RTPU-Tenant`` header or ``tenant`` body field), and
+``/advisez`` (the rule-driven advisor's evidence-linked findings;
+``?cluster=0`` keeps the pass local — obs/advisor.py). ``/healthz`` is
+graded ok|degraded|burning from the ``RTPU_SLO_TARGET`` error budgets
+(obs/budget.py). POST bodies additionally accept ``explain`` (truthy):
+the job's resource ledger rides back with ``/AnalysisResults``.
 
 Every POST runs under a ``rest.request`` span: the span's trace context
 is captured at submit and adopted by the job thread (obs/trace.py), so
@@ -38,8 +43,11 @@ import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..obs import budget as _budget
 from ..obs import ledger as _ledger
 from ..obs import slo as _slo
+from ..obs import workload as _workload
+from ..obs.advisor import ADVISOR
 from ..obs.sampler import SAMPLER
 from ..obs.trace import TRACER, TraceContext
 from ..utils.config import process_index, strided_port
@@ -122,6 +130,12 @@ def _statusz(manager: AnalysisManager,
         "fold_cache": _fold_cache_status(),
         "trace": TRACER.status(),
         "ledger": _ledger.status_block(),
+        # the judgment plane (PR 11): per-tenant workload accounts,
+        # error-budget grades, and the advisor's compact block — what
+        # /clusterz federates into the merged mesh view
+        "workload": _workload.WORKLOAD.status_block(),
+        "budget": _budget.BUDGET.status_block(),
+        "advisor": ADVISOR.status_block(),
         # the distributed half: which process this is, where its
         # listeners actually bound (what /clusterz discovery reads), and
         # what the cross-shard collectives moved
@@ -251,13 +265,23 @@ class _Handler(BaseHTTPRequestHandler):
             # results (/AnalysisResults gains a "ledger" block).
             explain = str(body.get("explain", "")).lower() \
                 in ("1", "true", "yes")
+            # tenant identity: the X-RTPU-Tenant header wins, a `tenant`
+            # body field backs it up. Normalization happens inside the
+            # job (obs/workload.py) and NEVER fails the request — a
+            # malformed value lands in the shared `invalid` account
+            tenant = self.headers.get(_workload.TENANT_HEADER)
+            if tenant is None or not tenant.strip():
+                # a present-but-blank header (proxy artifacts) must not
+                # suppress the body-field fallback
+                tenant = body.get("tenant")
             job = self.manager.submit(
                 program, q, job_id=body.get("jobID"),
                 sink_name=body.get("sinkName"),
                 sink_format=body.get("sinkFormat"),
-                explain=explain)
-            rsp.set(job_id=job.id)
-            payload = {"jobID": job.id, "status": job.status}
+                explain=explain, tenant=tenant)
+            rsp.set(job_id=job.id, tenant=job.tenant)
+            payload = {"jobID": job.id, "status": job.status,
+                       "tenant": job.tenant}
             # the submitter (or forwarding peer) learns the trace id
             # without polling /AnalysisResults — what the 2-process smoke
             # joins cross-process traces on. The handler span's trace IS
@@ -311,6 +335,24 @@ class _Handler(BaseHTTPRequestHandler):
             return self._text(200, SAMPLER.collapsed())
         self._json(200, SAMPLER.status())
 
+    def _advisez(self, qs: dict) -> None:
+        """Advisor surface (obs/advisor.py): one on-demand rule pass.
+        ``?cluster=0`` keeps it local; by default the pass federates the
+        peers' /statusz via the bounded /clusterz scraper so ONE process
+        advises on the whole mesh (straggler + skew rules need the
+        per-process rows). The scrape happens here on the request
+        thread, outside every lock — the advisor never does network I/O
+        from inside its registry."""
+        cluster = None
+        if qs.get("cluster", ["1"])[0] not in ("0", "false"):
+            from ..obs.cluster import clusterz
+
+            cluster = clusterz(
+                manager=self.manager, handler=self,
+                refresh=(qs.get("refresh", ["0"])[0]
+                         not in ("0", "false")))
+        self._json(200, ADVISOR.advisez(cluster=cluster))
+
     def do_GET(self):
         self._name_thread()
         # peer scrapes (/clusterz federation) carry X-RTPU-Trace: adopt
@@ -360,7 +402,12 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/Analysers":
                 return self._json(200, registry.names())
             if path == "/healthz":
-                return self._json(200, {"status": "ok"})
+                # graded from the error-budget state (obs/budget.py):
+                # ok|degraded|burning in the body; HTTP 503 on burning
+                # only under RTPU_HEALTH_STRICT=1, so load balancers can
+                # act on burn without parsing JSON
+                code, payload = _budget.healthz()
+                return self._json(code, payload)
             if path == "/statusz":
                 return self._json(200, _statusz(self.manager, self))
             if path == "/clusterz":
@@ -383,6 +430,11 @@ class _Handler(BaseHTTPRequestHandler):
                     200, _slo.slz_payload(_num_param(qs, "n", 120, int)))
             if path == "/profilez":
                 return self._profilez(qs)
+            if path == "/workloadz":
+                # per-tenant workload accounts (obs/workload.py)
+                return self._json(200, _workload.WORKLOAD.workloadz())
+            if path == "/advisez":
+                return self._advisez(qs)
             return self._json(404, {"error": f"unknown path {self.path}"})
         except KeyError as e:
             self._json(404, {"error": f"KeyError: {e}"})
@@ -417,8 +469,10 @@ class RestServer:
         handler.rest_base_port = int(port) or None
         self._thread: threading.Thread | None = None
         # the /slz series ring samples THIS manager's queue depth and
-        # in-flight jobs (weakly registered — the ring is process-wide)
+        # in-flight jobs (weakly registered — the ring is process-wide);
+        # the advisor reads the same manager's graph for watermark lag
         _slo.SERIES.attach_manager(manager)
+        ADVISOR.attach_manager(manager)
 
     def start(self) -> "RestServer":
         self._thread = threading.Thread(
@@ -431,6 +485,10 @@ class RestServer:
         # on them, and an idle 1 Hz sampler is noise)
         _slo.SERIES.start()
         SAMPLER.maybe_start()
+        # the periodic advisor tick (RTPU_ADVISOR gates it) — strictly
+        # read-only rule evaluation; same leave-running-on-stop contract
+        # as the ring and the sampler
+        ADVISOR.maybe_start()
         return self
 
     def stop(self) -> None:

@@ -11,6 +11,9 @@ from .trace import (TRACER, TraceContext, Tracer,   # stdlib-only —
 from .ledger import Ledger, REGISTRY, instrument   # stdlib-only (jax lazy)
 from .slo import SERIES, SLO                       # stdlib-only
 from .sampler import SAMPLER                       # stdlib-only
+from .workload import WORKLOAD                     # stdlib-only
+from .budget import BUDGET                         # stdlib-only
+from .advisor import ADVISOR                       # stdlib-only
 
 try:
     # metrics + device profiling need prometheus_client / jax, which
@@ -25,4 +28,4 @@ except ImportError:   # pragma: no cover — stripped environment
 __all__ = ["METRICS", "Metrics", "MetricsServer", "device_trace",
            "annotate", "TRACER", "TraceContext", "Tracer", "span",
            "Ledger", "REGISTRY", "instrument", "SLO", "SERIES",
-           "SAMPLER"]
+           "SAMPLER", "WORKLOAD", "BUDGET", "ADVISOR"]

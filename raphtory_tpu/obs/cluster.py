@@ -7,7 +7,9 @@ their head. ``/clusterz`` does the join server-side: ANY process scrapes
 its peers' ``/statusz`` (and, per trace id, ``/tracez``) and renders one
 merged cluster view — membership, per-process queue depth and watermark
 lag, per-route collective seconds/bytes/rows, per-shard halo/degree skew,
-per-process barrier wait, and cross-process traces reassembled by id.
+per-process barrier wait, cross-process traces reassembled by id, plus
+the judgment plane (PR 11): mesh-wide per-tenant workload totals and the
+union of firing advisor rules with per-process attribution.
 
 Design rules (the RT009/RT011 lint territory this module sits in):
 
@@ -253,6 +255,12 @@ def _peer_summary(status: dict) -> dict:
                 r.get("barrier_wait_seconds", 0.0)
                 for r in routes.values()), 6),
         },
+        # the judgment plane (PR 11): compact per-tenant totals, the
+        # error-budget grade, and the advisor's last-tick rule ids —
+        # already bounded at the source (/statusz embeds the same)
+        "workload": status.get("workload"),
+        "budget": status.get("budget"),
+        "advisor": status.get("advisor"),
     }
 
 
@@ -270,6 +278,48 @@ def _merge_members(processes: dict) -> dict:
             r["count"] += len(ids)
             r["by_process"][name] = ids
     return merged
+
+
+def _merge_workload(processes: dict) -> dict:
+    """Mesh-wide per-tenant totals: every reachable peer's compact
+    workload block summed by tenant with per-process attribution — an
+    operator asks "what is tenant X costing the CLUSTER", not one
+    process. Bounded: each peer ships at most its top-8 tenants."""
+    tenants: dict[str, dict] = {}
+    for name, p in processes.items():
+        wl = p.get("workload") if p.get("reachable") else None
+        if not wl:
+            continue
+        for tenant, row in (wl.get("tenants") or {}).items():
+            t = tenants.setdefault(tenant, {
+                "queries": 0, "cost_seconds": 0.0,
+                "queue_wait_seconds": 0.0, "by_process": {}})
+            t["queries"] += row.get("queries", 0)
+            t["cost_seconds"] = round(
+                t["cost_seconds"] + row.get("cost_seconds", 0.0), 6)
+            t["queue_wait_seconds"] = round(
+                t["queue_wait_seconds"]
+                + row.get("queue_wait_seconds", 0.0), 6)
+            t["by_process"][name] = row
+    top = sorted(tenants.items(), key=lambda kv: -kv[1]["cost_seconds"])
+    return {"n_tenants": len(tenants), "tenants": dict(top[:8])}
+
+
+def _merge_advisor(processes: dict) -> dict:
+    """Every reachable peer's advisor block: total findings + the union
+    of firing rule ids with per-process attribution."""
+    rules: dict[str, list] = {}
+    total = 0
+    for name, p in processes.items():
+        adv = p.get("advisor") if p.get("reachable") else None
+        if not adv:
+            continue
+        total += adv.get("findings", 0)
+        for rid in adv.get("rule_ids", []):
+            rules.setdefault(rid, []).append(name)
+    return {"findings": total,
+            "rules": {rid: sorted(names)
+                      for rid, names in sorted(rules.items())}}
 
 
 def clusterz(manager=None, handler=None, trace_id: str | None = None,
@@ -329,6 +379,8 @@ def clusterz(manager=None, handler=None, trace_id: str | None = None,
         "processes_reachable": reachable,
         "processes": processes,
         "members": _merge_members(processes),
+        "workload": _merge_workload(processes),
+        "advisor": _merge_advisor(processes),
         "stragglers": {
             name: p["collectives"]["barrier_wait_seconds"]
             for name, p in processes.items()

@@ -391,15 +391,18 @@ def instrument(name: str, fn,
 class Ledger:
     """Per-query resource accumulator — thread-safe (fold workers and the
     dispatch thread may record concurrently). ``merge()`` folds another
-    ledger's accounting in; no product driver uses it yet (the parallel
-    fold units report through the engines' own per-run accounting, which
-    ``add_sweep`` ingests) — it exists, tested, for the serving-scheduler
-    tentpole whose cross-tenant batches will need sub-ledgers."""
+    ledger's accounting in — the sub-ledger path: every completed job's
+    ledger merges into its tenant's long-lived account
+    (``obs/workload.py``), and the serving-scheduler tentpole's
+    cross-tenant batches will merge per-unit sub-ledgers the same way."""
 
     def __init__(self, query_id: str = "", algorithm: str = ""):
         self._lock = threading.Lock()
         self.query_id = query_id
         self.algorithm = algorithm
+        #: normalized tenant identity (obs/workload.py) — set by the jobs
+        #: layer at submit; "" for ledgers created outside the jobs path
+        self.tenant = ""
         #: trace id of the owning request's span tree ("" untraced) —
         #: set by the jobs layer so /costz ledgers join /tracez traces
         self.trace_id = ""
@@ -593,6 +596,7 @@ class Ledger:
         return {
             "query_id": self.query_id,
             "algorithm": self.algorithm,
+            "tenant": self.tenant,
             "trace_id": self.trace_id,
             "status": self.status,
             "queue_wait_seconds": round(self.queue_wait_seconds, 6),

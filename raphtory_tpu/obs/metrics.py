@@ -239,6 +239,50 @@ class Metrics:
             "queries (parallel/sharded.py exchange accounting) — the "
             "DCN/ICI column next to est HBM bytes in the ledger",
             ["algorithm"], registry=r)
+        # per-tenant workload accounts (obs/workload.py): WHO spent the
+        # budget. Label cardinality is PROVABLY bounded — tenant names
+        # pass normalize_tenant (malformed -> "invalid") and the
+        # RTPU_TENANT_CAP account cap (overflow -> "other") before ever
+        # reaching .labels()
+        self.tenant_queries = Counter(
+            "raphtory_tenant_queries_total",
+            "Completed jobs attributed to a tenant account",
+            ["tenant", "status"], registry=r)
+        self.tenant_cost_seconds = Counter(
+            "raphtory_tenant_cost_seconds_total",
+            "Attributed cost seconds by tenant and ledger phase "
+            "(queue_wait included as its own phase)",
+            ["tenant", "phase"], registry=r)
+        self.tenant_est_hbm_bytes = Counter(
+            "raphtory_tenant_est_hbm_bytes_total",
+            "Estimated device HBM bytes attributed to a tenant "
+            "(locality-aware per-dispatch traffic estimate)",
+            ["tenant"], registry=r)
+        self.tenant_dcn_bytes = Counter(
+            "raphtory_tenant_dcn_bytes_total",
+            "Estimated cross-shard collective bytes attributed to a "
+            "tenant", ["tenant"], registry=r)
+        # SLO error budgets (obs/budget.py): operator RTPU_SLO_TARGET
+        # targets judged as multi-window burn rates; label cardinality
+        # bounded by the parsed-target cap
+        self.slo_burn_rate = Gauge(
+            "raphtory_slo_burn_rate",
+            "Error-budget burn rate per target and window (1.0 = "
+            "spending exactly the allowed budget; >1 in both windows = "
+            "burning)", ["algorithm", "window"], registry=r)
+        self.slo_budget_remaining = Gauge(
+            "raphtory_slo_error_budget_remaining",
+            "Fraction of the error budget left over this process's "
+            "lifetime (1.0 = untouched, 0 = exhausted, negative = "
+            "overspent)", ["algorithm"], registry=r)
+        # advisor plane (obs/advisor.py): strictly read-only findings
+        self.advisor_findings = Gauge(
+            "raphtory_advisor_findings",
+            "Findings emitted by the last advisor tick, by rule",
+            ["rule"], registry=r)
+        self.advisor_ticks = Counter(
+            "raphtory_advisor_ticks_total",
+            "Advisor rule-evaluation passes", registry=r)
         # memory governor (Archivist signals)
         self.compactions = Counter(
             "raphtory_compactions_total",

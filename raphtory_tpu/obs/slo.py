@@ -190,6 +190,29 @@ class SLORegistry:
             h = self._hists.get((str(algorithm), str(phase)))
             return h.exemplar_near(q) if h is not None else None
 
+    def totals_below(self, algorithm: str, phase: str,
+                     threshold_s: float) -> tuple[int, int]:
+        """``(total, good)`` observation counts for ``algorithm``/
+        ``phase`` where *good* counts observations in buckets whose
+        upper bound is ≤ ``threshold_s`` — the error-budget numerator
+        (obs/budget.py). Algorithm matching is case-insensitive (targets
+        are operator-typed env strings; ledger algorithm labels are
+        class names). A threshold between bucket bounds counts its
+        bucket as BAD — conservative, and exact when targets align with
+        the (configurable) ``RTPU_SLO_BUCKETS`` grid."""
+        alg = str(algorithm).lower()
+        ph = str(phase)
+        total = good = 0
+        with self._lock:
+            for (a, p), h in self._hists.items():
+                if p != ph or a.lower() != alg:
+                    continue
+                total += h.count
+                for i, bound in enumerate(h.bounds):
+                    if bound <= threshold_s:
+                        good += h.counts[i]
+        return total, good
+
     def as_dict(self) -> dict:
         with self._lock:
             hists = {f"{alg}/{ph}": h.as_dict()
@@ -271,6 +294,14 @@ class SeriesRing:
     def register(self, name: str, fn) -> None:
         with self._lock:
             self._collectors[str(name)] = fn
+
+    def unregister(self, name: str) -> None:
+        """Drop a collector (unknown names are a no-op) — how the
+        error-budget registry retires a retargeted algorithm's
+        collectors instead of leaving dead histogram walks sampling at
+        1 Hz forever (obs/budget.py)."""
+        with self._lock:
+            self._collectors.pop(str(name), None)
 
     def attach_manager(self, manager) -> None:
         """Register job-table collectors for ``manager`` (weakly — the

@@ -206,7 +206,10 @@ def test_endpoints_over_live_rest_server(global_trace):
             return json.loads(urllib.request.urlopen(
                 f"http://127.0.0.1:{srv.port}{path}", timeout=10).read())
 
-        assert get("/healthz") == {"status": "ok"}
+        # graded liveness (obs/budget.py): with no RTPU_SLO_TARGET set
+        # there is nothing to burn, so the grade is "ok"
+        hz = get("/healthz")
+        assert hz["status"] == "ok" and hz["targets"] == []
 
         st = get("/statusz")
         assert st["jobs"][job.id] == "done"

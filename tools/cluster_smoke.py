@@ -14,7 +14,14 @@ proves the ISSUE-10 acceptance path end to end:
   membership, per-process watermark lag, nonzero per-route collective
   bytes, per-shard halo skew, and barrier-wait fields;
 * ``/clusterz?trace_id=`` must reassemble the trace with spans from
-  BOTH processes.
+  BOTH processes;
+* each worker's job carries its own ``X-RTPU-Tenant`` identity and the
+  merged ``/clusterz`` workload view must show BOTH tenant accounts
+  with per-process attribution (ISSUE-11);
+* finally worker 1 is DELAYED (a live source stops feeding, stalling
+  its watermark fence) and one federated ``/advisez`` pass on worker 0
+  must fire the ``cluster-straggler`` rule naming process 1 (ISSUE-11:
+  the advisor's distributed story).
 
 The federated snapshot is written to ``--out`` (the CI failure
 artifact). Exit 0 prints CLUSTERZ_OK; any assertion prints the evidence
@@ -149,11 +156,22 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
     sentinel = os.path.join(tmpdir, "driver_done")
 
     if idx == 1:
-        # serve until worker 0 finishes its assertions
+        # serve until worker 0 finishes its assertions; when asked,
+        # become the DELAYED member — a live source that never feeds
+        # holds this process's watermark fence still, so its lag grows
+        # while the peer's stays 0 (what the advisor's cluster-straggler
+        # rule reads, bar lowered to CI time via RTPU_ADVISOR_STALE_S)
         deadline = time.monotonic() + 600
+        injected = False
         while not os.path.exists(sentinel):
             if time.monotonic() > deadline:
                 raise TimeoutError("no driver_done sentinel")
+            if not injected and os.path.exists(
+                    os.path.join(tmpdir, "make_straggler")):
+                graph.watermarks.register("stalled-smoke")
+                injected = True
+                with open(os.path.join(tmpdir, "straggler_up"), "w") as f:
+                    f.write("ok")
             time.sleep(0.25)
         srv.stop()
         print("worker 1 ok", flush=True)
@@ -164,15 +182,21 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
     body = {"analyserName": "PageRank", "timestamp": latest,
             "windowType": "batched", "windowSet": [800, 200],
             "params": {"max_steps": 10, "tol": 0.0}}
-    sub0 = _http_json(f"{me}/ViewAnalysisRequest", body)
+    sub0 = _http_json(f"{me}/ViewAnalysisRequest", body,
+                      headers={"X-RTPU-Tenant": "smoke-w0"})
     tid = sub0.get("traceID")
     assert tid, f"no traceID in submit response: {sub0}"
-    # forward the hop: the SAME trace id crosses the process boundary
+    assert sub0.get("tenant") == "smoke-w0", sub0
+    # forward the hop: the SAME trace id crosses the process boundary,
+    # under the PEER's tenant identity (the merged workload view must
+    # attribute each account to its own process)
     wire = TraceContext(tid, 0, origin=idx).to_wire()
     sub1 = _http_json(f"{peer}/ViewAnalysisRequest", body,
-                      headers={TraceContext.HEADER: wire})
+                      headers={TraceContext.HEADER: wire,
+                               "X-RTPU-Tenant": "smoke-w1"})
     assert sub1.get("traceID") == tid, (
         f"peer opened its own trace: {sub1} != {tid}")
+    assert sub1.get("tenant") == "smoke-w1", sub1
     _wait_done(me, sub0["jobID"])
     _wait_done(peer, sub1["jobID"])
 
@@ -209,6 +233,27 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
     with_spans = czt["trace"]["processes_with_spans"]
     assert set(with_spans) >= {"process_0", "process_1"}, (
         f"trace {tid} not reassembled from both processes: {with_spans}")
+
+    # ---- per-tenant accounts in the MERGED mesh view (ISSUE-11):
+    # each worker's job landed in its own tenant account, attributed to
+    # its own process, summed cluster-wide by /clusterz. A job's REST
+    # status flips to done BEFORE its ledger publishes into the account
+    # (jobs/manager.py ordering), so re-scrape briefly rather than read
+    # one racy snapshot
+    deadline = time.monotonic() + 30
+    while True:
+        tenants = (cz.get("workload") or {}).get("tenants") or {}
+        if {"smoke-w0", "smoke-w1"} <= set(tenants):
+            break
+        if time.monotonic() > deadline:
+            raise AssertionError(f"tenant accounts never federated: "
+                                 f"{tenants}")
+        time.sleep(0.5)
+        cz = _http_json(f"{me}/clusterz?refresh=1")
+    assert "process_0" in tenants["smoke-w0"]["by_process"], tenants
+    assert "process_1" in tenants["smoke-w1"]["by_process"], tenants
+    assert tenants["smoke-w0"]["queries"] >= 1, tenants
+    assert tenants["smoke-w0"]["cost_seconds"] > 0, tenants
 
     # ---- optional bench mode: interleaved telemetry off/on pairs ----
     if pairs > 0:
@@ -260,6 +305,41 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
             {"pairs": ab, "clusterz_scrape_seconds": round(scrape_s, 4),
              "n_views": n_hops * 4}), flush=True)
 
+    # ---- straggler injection (ISSUE-11): worker 1 delays — its
+    # watermark fence stops advancing — and a federated /advisez pass
+    # HERE must fire the cluster-straggler rule naming process 1. The
+    # bar is CI-sized via RTPU_ADVISOR_STALE_S=2 (driver env); worker
+    # 1's lag clock starts at its ingestion end, so the signal towers
+    # over the bar the moment the stalled source registers.
+    with open(os.path.join(tmpdir, "make_straggler"), "w") as f:
+        f.write("go")
+    deadline = time.monotonic() + 60
+    while not os.path.exists(os.path.join(tmpdir, "straggler_up")):
+        if time.monotonic() > deadline:
+            raise TimeoutError("worker 1 never injected its straggler")
+        time.sleep(0.2)
+    az = finding = None
+    deadline = time.monotonic() + 90
+    while finding is None and time.monotonic() < deadline:
+        az = _http_json(f"{me}/advisez?refresh=1", timeout=30.0)
+        finding = next((f for f in az["findings"]
+                        if f["rule_id"] == "cluster-straggler"), None)
+        if finding is None:
+            time.sleep(1.0)
+    if out:   # the snapshot grows the advisor's verdict (or its absence)
+        with open(out, "w") as f:
+            json.dump({"clusterz": cz, "trace": czt["trace"],
+                       "trace_id": tid, "advisez": az}, f, indent=1,
+                      default=str)
+    assert finding is not None, (
+        f"cluster-straggler never fired: {az and az['findings']}")
+    ev = finding["evidence"]
+    assert ev["process"] == "process_1", ev
+    assert ev["process_index"] == 1, ev
+    assert ev["watermark_lag_by_process"]["process_1"] > \
+        ev["watermark_lag_by_process"]["process_0"], ev
+    print("STRAGGLER_OK", flush=True)
+
     with open(sentinel, "w") as f:
         f.write("ok")
     srv.stop()
@@ -304,6 +384,9 @@ def run_cluster(out: str | None = None, pairs: int = 0,
     # bind worker 1 two ports up and the smoke would poll a dead port
     env["RTPU_PORT_STRIDE"] = "1"
     env.pop("RTPU_CLUSTER_PEERS", None)   # derive from the topology
+    # CI-sized staleness bar for the straggler phase: worker 1's stalled
+    # fence must clear it in smoke time, not the 30 s production default
+    env["RTPU_ADVISOR_STALE_S"] = "2"
     procs = []
     for i in (0, 1):
         cmd = [sys.executable, os.path.abspath(__file__),
