@@ -142,6 +142,14 @@ def _device_edges(log, tables):
     es = device_put_chunked(tables.e_src)
     ed = device_put_chunked(tables.e_dst)
     _DEVICE_EDGES[log] = (tables.m, tables.n, es, ed)
+    # resident-buffer gauge (obs/device.py): the static edge tables are
+    # the largest long-lived device allocation — weakref-keyed on the
+    # SAME log object as the cache above, so the row dies with the entry
+    from ..obs import device as _obs_device
+
+    _obs_device.RESIDENT.track(
+        log, "edge_tables",
+        _obs_device.nbytes_tree((es, ed)), m=tables.m)
     return es, ed
 
 
@@ -330,6 +338,14 @@ class DeviceSweep:
             jnp.zeros((self.m_pad,), bool),              # e_alive
             jnp.full((self.m_pad,), self._tmin, tdt),    # e_first
         )
+        # resident-buffer gauge (obs/device.py): the fold-state buffers
+        # live exactly as long as this sweep — weakref-keyed on self
+        from ..obs import device as _obs_device
+
+        _obs_device.RESIDENT.track(
+            self, "fold_state",
+            _obs_device.nbytes_tree(self._bufs)
+            + _obs_device.nbytes_tree((self.vids,)))
         # delta chunk capacities: big enough that a typical hop is one chunk,
         # fixed so the scatter program compiles exactly once per sweep shape
         self.cap_v = max(1024, self.n_pad // 4)

@@ -827,6 +827,16 @@ class _HopBatched:
             self._edges = _device_edges(self._log, self.tables)
         return self._edges[1]
 
+    def _drop_residency(self) -> None:
+        """Forget the device-resident advanced base AND retire its
+        resident-gauge row (obs/device.py) — every site that invalidates
+        residency goes through here, or /devicez keeps reporting device
+        bytes the backend already freed."""
+        self._dev_base = None
+        from ..obs import device as _obs_device
+
+        _obs_device.RESIDENT.drop(self, "advanced_base")
+
     def _delta_base_args(self, ship_base):
         """(base_for_dispatch, h0_delta): the device-resident advanced
         state when the fold shipped no base snapshot, else the host
@@ -843,14 +853,21 @@ class _HopBatched:
         any dispatch-time failure drops residency so the next batch falls
         back to shipping a fresh base snapshot (execute-time failures are
         the jobs layer's concern — it rebuilds the engine)."""
+        from ..obs import device as _obs_device
+
         try:
             out, steps, adv = fn()
         except Exception:
-            self._dev_base = None
+            self._drop_residency()
             raise
         self._dev_base = adv
         self._dev_base_spec = (None if self._active_layout is None
                                else self._active_layout.spec)
+        # resident-buffer gauge (obs/device.py): the advanced base is
+        # what the next batch scatters onto instead of shipping a full
+        # snapshot — a live row, re-upserted per delta dispatch
+        _obs_device.RESIDENT.track(self, "advanced_base",
+                                   _obs_device.nbytes_tree(adv))
         return out, steps
 
     def _sync_layout(self):
@@ -866,7 +883,7 @@ class _HopBatched:
                                  _tile_budget_bytes())
         spec = None if lay is None else lay.spec
         if self._dev_base is not None and self._dev_base_spec != spec:
-            self._dev_base = None
+            self._drop_residency()
         self._active_layout = lay
         return lay
 
@@ -968,7 +985,7 @@ class _HopBatched:
             # that window (last_delta only spans the latest advance), so
             # the next batch must re-materialise from the sweep's full
             # state, not snapshot the stale running base.
-            self._dev_base = None
+            self._drop_residency()
             self._delta_base = None
             raise
 
@@ -1127,7 +1144,7 @@ class _HopBatched:
                     # an older catch-up delta onto that newer state. Drop
                     # residency: the next batch ships a base from the
                     # host clock, which is always consistent.
-                    self._dev_base = None
+                    self._drop_residency()
                     return jnp.concatenate(outs, axis=0), steps_box[0]
                 # cached without shells but this job needs them: refold
             led = _ledger.current()
@@ -1289,7 +1306,7 @@ class _HopBatched:
             # like serial ``_fold_columns``, or a later delta batch would
             # scatter onto a device state frozen several batches back
             self._delta_base = None
-            self._dev_base = None
+            self._drop_residency()
             cols_out = [self._alloc_columns(len(g)) for g in groups]
         cap = [] if key is not None else None
         cb = self._capture_cb(hop_callback, cap)
@@ -1501,7 +1518,7 @@ class _HopBatched:
         # running delta base — a later delta-fold call must rebuild it or
         # it would scatter one hop's delta onto a stale base
         self._delta_base = None
-        self._dev_base = None
+        self._drop_residency()
         t = self.tables
         hop_times = [int(x) for x in hop_times]
         if sorted(hop_times) != hop_times:

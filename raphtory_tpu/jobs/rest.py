@@ -25,7 +25,12 @@ sampling profiler: JSON status, ``?format=collapsed`` flamegraph lines,
 accounts rolled up from the query ledgers — obs/workload.py; POSTs may
 carry an ``X-RTPU-Tenant`` header or ``tenant`` body field), and
 ``/advisez`` (the rule-driven advisor's evidence-linked findings;
-``?cluster=0`` keeps the pass local — obs/advisor.py). ``/healthz`` is
+``?cluster=0`` keeps the pass local — obs/advisor.py), and ``/devicez``
+(the measured device runtime: sampled kernel latencies joined with the
+estimates, measured-vs-estimated divergence and ``bound_measured``,
+device-memory snapshot or its honest degrade, the resident-buffer
+registry, and recent XLA compile events with the compile-storm signal —
+obs/device.py). ``/healthz`` is
 graded ok|degraded|burning from the ``RTPU_SLO_TARGET`` error budgets
 (obs/budget.py). POST bodies additionally accept ``explain`` (truthy):
 the job's resource ledger rides back with ``/AnalysisResults``.
@@ -44,6 +49,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..obs import budget as _budget
+from ..obs import device as _device
 from ..obs import ledger as _ledger
 from ..obs import slo as _slo
 from ..obs import workload as _workload
@@ -96,6 +102,10 @@ def _compile_cache_sizes() -> dict:
             ci = info()
             out[f"{short}.{nm}"] = {"size": ci.currsize, "hits": ci.hits,
                                     "misses": ci.misses}
+    # the measured compile half (obs/device.py): per-kernel XLA compile
+    # counts/seconds/last-shape-sig observed at the registry's
+    # lower().compile() sites — next to the factory lru stats above
+    out["kernels"] = _device.compile_block()
     return out
 
 
@@ -136,6 +146,10 @@ def _statusz(manager: AnalysisManager,
         "workload": _workload.WORKLOAD.status_block(),
         "budget": _budget.BUDGET.status_block(),
         "advisor": ADVISOR.status_block(),
+        # the measured device plane (PR 12): sampled kernel-timing
+        # totals, the memory snapshot (or its honest degrade), resident
+        # bytes, and the compile-storm signal — what /clusterz federates
+        "device": _device.status_block(),
         # the distributed half: which process this is, where its
         # listeners actually bound (what /clusterz discovery reads), and
         # what the cross-shard collectives moved
@@ -424,6 +438,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # per-kernel harvested XLA cost/memory analysis with the
                 # roofline classification + recent per-query ledgers
                 return self._json(200, _ledger.costz())
+            if path == "/devicez":
+                # the measured device plane (obs/device.py): sampled
+                # kernel latencies joined with estimates (divergence +
+                # bound_measured), device memory (or its degrade),
+                # resident buffers, recent compile events + storm
+                return self._json(200, _device.devicez())
             if path == "/slz":
                 # SLO histograms + trace exemplars + the series ring
                 return self._json(

@@ -9,6 +9,7 @@ AspectJ weaver has no analogue for)."""
 from .trace import (TRACER, TraceContext, Tracer,   # stdlib-only —
                     span)                           # always available
 from .ledger import Ledger, REGISTRY, instrument   # stdlib-only (jax lazy)
+from .device import RESIDENT, TIMING               # stdlib-only (jax lazy)
 from .slo import SERIES, SLO                       # stdlib-only
 from .sampler import SAMPLER                       # stdlib-only
 from .workload import WORKLOAD                     # stdlib-only
@@ -28,4 +29,5 @@ except ImportError:   # pragma: no cover — stripped environment
 __all__ = ["METRICS", "Metrics", "MetricsServer", "device_trace",
            "annotate", "TRACER", "TraceContext", "Tracer", "span",
            "Ledger", "REGISTRY", "instrument", "SLO", "SERIES",
-           "SAMPLER", "WORKLOAD", "BUDGET", "ADVISOR"]
+           "SAMPLER", "WORKLOAD", "BUDGET", "ADVISOR", "RESIDENT",
+           "TIMING"]

@@ -50,6 +50,7 @@ from collections import deque
 from ..analysis.sanitizer import (note_shared as _san_note,
                                   track_shared as _san_track)
 from . import budget as _budget
+from . import device as _device
 from . import ledger as _ledger
 from . import workload as _workload
 from .slo import _metrics
@@ -343,6 +344,115 @@ def rule_watermark_stale(sig: dict) -> dict | None:
         severity="warning")
 
 
+# ---- device rules: evaluate over the obs/device measured plane ----
+
+#: mutual-divergence band for the model-divergence rule: per-kernel
+#: measured/predicted ratios spreading wider than this say the cost
+#: model RANKS kernels wrongly. Deliberately scale-invariant — the
+#: platform peaks are order-of-magnitude anchors, so an absolute
+#: measured-vs-predicted gap is expected (and constant-ratio gaps keep
+#: the bound classification correct); inconsistent ratios do not.
+DIVERGENCE_BAND = 16.0
+#: measured evidence floors before the divergence rule may speak
+DIVERGENCE_MIN_SAMPLES = 4
+DIVERGENCE_MIN_KERNELS = 2
+
+
+def divergence_band() -> float:
+    try:
+        v = float(os.environ.get("RTPU_ADVISOR_DIVERGENCE", "")
+                  or DIVERGENCE_BAND)
+        return max(1.5, v)
+    except ValueError:
+        return DIVERGENCE_BAND
+
+
+def rule_model_divergence(sig: dict) -> dict | None:
+    """Per-kernel measured-vs-predicted ratios are mutually inconsistent
+    past the band — the roofline/traffic model mis-RANKS kernels, so
+    ``bound_refined`` (and any controller trusting it) should be
+    distrusted until the model is recalibrated against the measured
+    table. Scale-invariant on purpose: a constant absolute offset (rough
+    platform anchors) never fires this."""
+    rows = (sig.get("device") or {}).get("timing") or []
+    rated = {}
+    for r in rows:
+        m = r.get("measured") or {}
+        # overhead_bound rows are excluded: when dispatch overhead
+        # dominates (small kernels, CPU rigs) the ratio judges the
+        # overhead, not the model's ranking — including them would fire
+        # this on every healthy host with mixed kernel sizes
+        if (m.get("samples", 0) >= DIVERGENCE_MIN_SAMPLES
+                and r.get("divergence")
+                and r.get("bound_measured") != "overhead_bound"):
+            rated[f"{r.get('kernel')}[{r.get('sig')}]"] = \
+                float(r["divergence"])
+    if len(rated) < DIVERGENCE_MIN_KERNELS:
+        return None
+    worst = max(rated, key=rated.get)
+    best = min(rated, key=rated.get)
+    spread = rated[worst] / max(rated[best], 1e-12)
+    if spread < divergence_band():
+        return None
+    return _finding(
+        "device-model-divergence",
+        f"measured/predicted kernel-seconds ratios spread {spread:.1f}x "
+        f"across kernels (band: {divergence_band():.0f}x) — the cost "
+        "model mis-ranks kernels; bound_refined is not trustworthy",
+        "RTPU_LEDGER_RIDGE",
+        "distrust bound_refined until recalibrated: check the measured "
+        f"table on /devicez (worst {worst}, best {best}); set "
+        "RTPU_LEDGER_RIDGE from measured achieved FLOP/s / bytes/s, or "
+        "fix the traffic model for the out-of-band kernel",
+        {"divergence_by_kernel": {k: round(v, 3)
+                                  for k, v in sorted(rated.items())},
+         "spread": round(spread, 3), "band": divergence_band(),
+         "worst": worst, "best": best})
+
+
+def rule_device_pressure(sig: dict) -> dict | None:
+    """Device memory near its limit, OR a request-path compile storm
+    (new shape sigs recompiling under load faster than they amortise) —
+    either way the device runtime is under pressure and a knob exists."""
+    dev = sig.get("device") or {}
+    mem = dev.get("memory") or {}
+    if mem.get("available") and mem.get("bytes_limit"):
+        frac = mem["bytes_in_use"] / mem["bytes_limit"]
+        if frac >= 0.9:
+            return _finding(
+                "device-pressure",
+                f"device memory at {frac:.0%} of its "
+                f"{mem['bytes_limit']} byte limit — the next allocation "
+                "spills or OOMs",
+                "RTPU_TILE_BUDGET_MB",
+                "lower RTPU_TILE_BUDGET_MB (shrinks the columnar edge "
+                "tile), raise RTPU_PARTITIONS, or shed resident engines "
+                "(see the /devicez resident registry for what is "
+                "pinned)",
+                {"memory": mem,
+                 "resident_bytes": dev.get("resident_bytes")},
+                severity="warning")
+    comp = dev.get("compile") or {}
+    if (comp.get("events_in_window", 0) >= comp.get(
+            "threshold", _device.storm_threshold())
+            and comp.get("distinct_sigs_in_window", 0)
+            >= max(4, int(comp.get("threshold", 16)) // 4)):
+        return _finding(
+            "device-pressure",
+            f"compile storm: {comp['events_in_window']} XLA compiles "
+            f"({comp.get('distinct_sigs_in_window')} distinct shape "
+            f"sigs) inside the last {comp.get('window_seconds')}s — "
+            "request traffic is shape-diverse enough to recompile "
+            "faster than programs amortise",
+            "RTPU_COMPILE_CACHE_DIR",
+            "set RTPU_COMPILE_CACHE_DIR (persistent compile cache), "
+            "and bucket/pad request shapes upstream so distinct sigs "
+            "collapse; /devicez lists the recent compile events",
+            {"compile": comp},
+            severity="warning")
+    return None
+
+
 # ---- cluster rules: evaluate over the /clusterz processes dict ----
 
 
@@ -437,6 +547,13 @@ RULES = (
     ("watermark-stale", rule_watermark_stale,
      "watermark lag + source snapshot",
      "the safe-time fence stopped advancing past the staleness bar"),
+    ("device-model-divergence", rule_model_divergence,
+     "/devicez measured kernel table (sampled timings vs model)",
+     "measured/predicted ratios mutually inconsistent past the band — "
+     "distrust bound_refined"),
+    ("device-pressure", rule_device_pressure,
+     "/devicez memory snapshot + compile-storm window",
+     "device memory near its limit, or a request-path compile storm"),
     ("cluster-straggler", rule_cluster_straggler,
      "/clusterz per-process watermark lag + barrier waits",
      "one process's lag towers over the mesh"),
@@ -479,6 +596,14 @@ def gather_signals(manager=None, cluster: dict | None = None) -> dict:
                  "RTPU_TRANSFER_DEPTH", "RTPU_FOLD_CACHE_MB")},
         "cluster": cluster,
     }
+    try:
+        # the measured device plane (obs/device.py): sampled kernel
+        # timings joined with estimates, memory snapshot, compile storm
+        sig["device"] = _device.advisor_signals()
+        sig["device"]["resident_bytes"] = \
+            _device.RESIDENT.snapshot()["total_bytes"]
+    except Exception:
+        sig["device"] = {}
     try:
         from ..utils.transfer import shared_engine
 

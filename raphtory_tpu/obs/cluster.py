@@ -261,6 +261,9 @@ def _peer_summary(status: dict) -> dict:
         "workload": status.get("workload"),
         "budget": status.get("budget"),
         "advisor": status.get("advisor"),
+        # the measured device plane (PR 12): timing totals, memory
+        # snapshot (or degrade), resident bytes, compile-storm signal
+        "device": status.get("device"),
     }
 
 
@@ -303,6 +306,33 @@ def _merge_workload(processes: dict) -> dict:
             t["by_process"][name] = row
     top = sorted(tenants.items(), key=lambda kv: -kv[1]["cost_seconds"])
     return {"n_tenants": len(tenants), "tenants": dict(top[:8])}
+
+
+def _merge_device(processes: dict) -> dict:
+    """Every reachable peer's device block: mesh-wide resident bytes,
+    per-process memory occupancy, and which processes are inside a
+    compile storm — the measured plane's cluster view."""
+    resident_total = 0
+    memory: dict[str, dict] = {}
+    storms: list[str] = []
+    measured = 0
+    for name, p in processes.items():
+        dev = p.get("device") if p.get("reachable") else None
+        if not dev:
+            continue
+        resident_total += int(dev.get("resident_bytes") or 0)
+        mem = dev.get("memory") or {}
+        memory[name] = (mem if mem.get("available")
+                        else {"available": False})
+        comp = dev.get("compile") or {}
+        if comp.get("storm"):
+            storms.append(name)
+        measured += int((dev.get("timing") or {})
+                        .get("kernels_measured") or 0)
+    return {"resident_bytes_total": resident_total,
+            "kernels_measured_total": measured,
+            "memory_by_process": memory,
+            "compile_storms": sorted(storms)}
 
 
 def _merge_advisor(processes: dict) -> dict:
@@ -381,6 +411,7 @@ def clusterz(manager=None, handler=None, trace_id: str | None = None,
         "members": _merge_members(processes),
         "workload": _merge_workload(processes),
         "advisor": _merge_advisor(processes),
+        "device": _merge_device(processes),
         "stragglers": {
             name: p["collectives"]["barrier_wait_seconds"]
             for name, p in processes.items()

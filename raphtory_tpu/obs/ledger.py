@@ -62,6 +62,7 @@ import time
 
 from ..analysis.sanitizer import (note_shared as _san_note,
                                   track_shared as _san_track)
+from . import device as _device
 from .trace import TRACER
 
 #: (peak FLOP/s, peak memory bandwidth B/s) operating points per backend —
@@ -209,6 +210,15 @@ class KernelRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._kernels: dict[tuple, dict] = {}
+        #: keys whose record was created but not yet harvested — the
+        #: dispatch wrapper's harvest trigger. Lives HERE (not in a
+        #: per-wrapper seen-set) so a cap-evicted key re-harvests when
+        #: traffic brings it back: the estimates died with the record
+        self._pending_harvest: set[tuple] = set()
+        #: entries dropped by the RTPU_KERNEL_REGISTRY_CAP bound —
+        #: shape-diverse request traffic must not grow the registry
+        #: without bound (rtpulint RT011)
+        self.evictions = 0
         # lockset-sanitizer registration (None unless RTPU_SANITIZE):
         # every registry access reports its held lockset — an unguarded
         # path shows up as a shared-state-race finding
@@ -217,22 +227,83 @@ class KernelRegistry:
     def _note_shared(self, write: bool) -> None:
         _san_note(self._san_tracker, write)
 
+    @staticmethod
+    def _new_record(name: str, sig: tuple) -> dict:
+        return {
+            "kernel": name, "sig": "×".join(sig),
+            "dispatches": 0, "mode": "host", "bound": "unknown",
+            "flops": None, "bytes_accessed": None,
+            "temp_bytes": None, "argument_bytes": None,
+            "output_bytes": None, "intensity": None,
+            "est_hbm_bytes": None, "bound_refined": None,
+        }
+
+    def _create_locked(self, key: tuple) -> tuple[dict, list]:
+        """Insert a fresh record for ``key`` (caller holds the lock and
+        verified absence) and run the LRU cap eviction (every touch
+        re-inserts at the back, so the front is the COLDEST key, not the
+        first-registered — a hot kernel's estimates survive). Returns
+        (record, evicted keys); the caller runs the device-plane timing
+        hook on the evicted keys AFTER releasing the lock."""
+        rec = self._kernels[key] = self._new_record(*key)
+        evicted = _device.evict_past_cap(
+            self._kernels, _device.registry_cap(), key)
+        self.evictions += len(evicted)
+        for old in evicted:
+            self._pending_harvest.discard(old)
+        return rec, evicted
+
     def _ensure(self, name: str, sig: tuple) -> dict:
         key = (name, sig)
+        evicted: list[tuple] = []
         with self._lock:
             self._note_shared(write=True)
             rec = self._kernels.get(key)
             if rec is None:
-                rec = {
-                    "kernel": name, "sig": "×".join(sig),
-                    "dispatches": 0, "mode": "host", "bound": "unknown",
-                    "flops": None, "bytes_accessed": None,
-                    "temp_bytes": None, "argument_bytes": None,
-                    "output_bytes": None, "intensity": None,
-                    "est_hbm_bytes": None, "bound_refined": None,
-                }
-                self._kernels[key] = rec
-            return rec
+                rec, evicted = self._create_locked(key)
+                self._pending_harvest.add(key)
+            else:
+                self._kernels[key] = self._kernels.pop(key)  # LRU touch
+        for old in evicted:
+            _device.TIMING.evict(old)
+        return rec
+
+    def touch(self, name: str, sig: tuple) -> tuple[dict, bool]:
+        """The dispatch wrapper's pre-call, ONE lock acquisition:
+        get-or-create the record, LRU-touch it, and report whether it
+        still needs its harvest (consumed here — exactly once per LIVE
+        record). Registry-owned freshness (not a per-wrapper seen-set):
+        a key whose record was cap-evicted re-harvests when traffic
+        brings it back, instead of serving host-mode Nones forever."""
+        key = (name, sig)
+        evicted: list[tuple] = []
+        fresh = False
+        with self._lock:
+            self._note_shared(write=True)
+            rec = self._kernels.get(key)
+            if rec is None:
+                rec, evicted = self._create_locked(key)
+                fresh = True   # created-and-consumed in one step
+            else:
+                self._kernels[key] = self._kernels.pop(key)  # LRU touch
+                if key in self._pending_harvest:   # _ensure-created rec
+                    self._pending_harvest.discard(key)
+                    fresh = True
+        for old in evicted:
+            _device.TIMING.evict(old)
+        return rec, fresh
+
+    def needs_harvest(self, name: str, sig: tuple) -> bool:
+        """``touch``'s freshness flag alone (tests + direct callers)."""
+        return self.touch(name, sig)[1]
+
+    def record_dispatch(self, rec: dict) -> None:
+        """Count one dispatch on an already-touched record — the
+        wrapper's post-call, one lock acquisition (``touch`` did the
+        lookup; re-resolving the key would double the hot-path cost)."""
+        with self._lock:
+            self._note_shared(write=True)
+            rec["dispatches"] += 1
 
     def harvest(self, name: str, sig: tuple, fn, args,
                 traffic: dict | None = None) -> dict:
@@ -261,8 +332,16 @@ class KernelRegistry:
             return rec
         try:
             t0 = time.perf_counter()
-            compiled = fn.lower(*args).compile()
+            # the ONE compile site of the registry (shares the in-memory
+            # XLA cache with the dispatch path) — spanned + recorded so
+            # compile counts/seconds/shape-sigs are observable and a
+            # request-path recompile burst is a detectable storm
+            # (obs/device.py compile plane)
+            with TRACER.span("xla.compile", kernel=name,
+                             sig="×".join(sig)):
+                compiled = fn.lower(*args).compile()
             harvest_s = time.perf_counter() - t0
+            _device.note_compile(name, "×".join(sig), harvest_s)
             updates: dict = {"mode": "xla",
                              "harvest_seconds": round(harvest_s, 4)}
             if caps["cost"]:
@@ -302,13 +381,6 @@ class KernelRegistry:
                 rec["harvest_error"] = f"{type(e).__name__}: {e}"[:200]
         return rec
 
-    def note_dispatch(self, name: str, sig: tuple) -> dict:
-        rec = self._ensure(name, sig)
-        with self._lock:
-            self._note_shared(write=True)
-            rec["dispatches"] += 1
-        return rec
-
     def snapshot(self) -> list[dict]:
         with self._lock:
             self._note_shared(write=False)
@@ -326,6 +398,7 @@ class KernelRegistry:
     def clear(self) -> None:
         with self._lock:
             self._kernels.clear()
+            self._pending_harvest.clear()
 
 
 #: the process singleton every instrumented engine records into
@@ -337,37 +410,61 @@ class InstrumentedKernel:
     straight through to the jitted callable (donation, async dispatch and
     the C++ fast path untouched), while the wrapper counts the dispatch
     into the registry and the active query ledger, and harvests XLA
-    analysis once per argument-shape signature. With ``RTPU_LEDGER=0``
-    the wrapper is a single env-read passthrough."""
+    analysis once per LIVE (kernel, argument-shape-signature) registry
+    record (a cap-evicted signature re-harvests on return). With
+    ``RTPU_LEDGER=0`` the wrapper is a single env-read passthrough."""
 
-    __slots__ = ("name", "fn", "traffic", "_seen", "_lock")
+    __slots__ = ("name", "fn", "traffic")
 
     def __init__(self, name: str, fn, traffic: dict | None = None):
         self.name = name
         self.fn = fn
         self.traffic = traffic
-        self._seen: set = set()
-        self._lock = threading.Lock()
 
     def __call__(self, *args):
         if not _enabled():
             return self.fn(*args)
         sig = _sig_of(args)
-        with self._lock:
-            fresh = sig not in self._seen
-            if fresh:
-                self._seen.add(sig)
+        # freshness is REGISTRY-owned (not a per-wrapper seen-set): a
+        # cap-evicted (kernel, sig) whose traffic returns re-harvests
+        # instead of serving host-mode Nones forever, and the wrapper
+        # carries no per-shape state of its own (RT011). One lock
+        # acquisition pre-call (touch), one post-call (record_dispatch).
+        rec, fresh = REGISTRY.touch(self.name, sig)
         if fresh:
             # BEFORE the dispatch: donated buffers must still be alive
             # when lower() traces; the AOT compile lands in (or seeds)
             # the same in-memory XLA cache the call below hits
             REGISTRY.harvest(self.name, sig, self.fn, args,
                              traffic=self.traffic)
+        # sampled timed dispatch (obs/device.py): a sampled call blocks
+        # until the result is ready and records wall device seconds —
+        # sampling because an always-on sync would destroy the transfer
+        # pipelining; cold (first-ever) samples are recorded apart
+        timed, cold = _device.TIMING.should_sample(self.name, sig)
+        if timed:
+            t0 = time.perf_counter()
         out = self.fn(*args)
-        rec = REGISTRY.note_dispatch(self.name, sig)
+        measured = False
+        seconds = 0.0
+        if timed and _device.block_ready(out):
+            # a FAILED sync is a lost sample, never an observation: the
+            # unsynced duration is enqueue time and would poison the
+            # percentiles the divergence/bound_measured math reads
+            seconds = time.perf_counter() - t0
+            measured = True
+            _device.TIMING.observe(self.name, sig, seconds, cold=cold)
+        REGISTRY.record_dispatch(rec)
         led = current()
         if led is not None:
             led.count_dispatch(self.name, rec)
+            if measured and not cold:
+                led.count_measured(self.name, seconds)
+                # the synced instant is also the cheapest honest moment
+                # to read the device-memory counter into the query
+                snap = _device.memory_snapshot()
+                if snap.get("available"):
+                    led.note_device_memory(snap["bytes_in_use"])
         return out
 
     # the REST compile-cache introspection walks factories; keep the
@@ -426,6 +523,9 @@ class Ledger:
         self.supersteps = 0
         self.hops = 0
         self.peak_rss_bytes = 0
+        #: max device bytes-in-use observed at sampled timed dispatches
+        #: (+ one read at finish) — 0 on backends without memory_stats
+        self.peak_device_bytes = 0
 
     # ---- recording ----
 
@@ -500,6 +600,26 @@ class Ledger:
             if rec.get("bound_refined"):
                 k["bound_refined"] = rec["bound_refined"]
 
+    def count_measured(self, name: str, seconds: float) -> None:
+        """One sampled timed dispatch's measured wall device seconds
+        (obs/device.py) — joins the kernel's estimate columns so
+        ``explain:1`` carries measured next to estimated."""
+        with self._lock:
+            k = self.kernels.get(name)
+            if k is None:
+                k = self.kernels[name] = {
+                    "dispatches": 0, "est_flops": 0.0,
+                    "est_bytes_accessed": 0.0, "est_hbm_bytes": 0.0,
+                    "bound": "unknown"}
+            k["measured_seconds"] = round(
+                k.get("measured_seconds", 0.0) + float(seconds), 6)
+            k["timed_dispatches"] = k.get("timed_dispatches", 0) + 1
+
+    def note_device_memory(self, bytes_in_use: int) -> None:
+        with self._lock:
+            self.peak_device_bytes = max(self.peak_device_bytes,
+                                         int(bytes_in_use))
+
     def count_views(self, n: int = 1) -> None:
         with self._lock:
             self.views += int(n)
@@ -545,23 +665,40 @@ class Ledger:
                     mine["est_hbm_bytes"] = (
                         mine.get("est_hbm_bytes", 0.0)
                         + k.get("est_hbm_bytes", 0.0))
+                    if k.get("timed_dispatches"):
+                        mine["measured_seconds"] = round(
+                            mine.get("measured_seconds", 0.0)
+                            + k.get("measured_seconds", 0.0), 6)
+                        mine["timed_dispatches"] = (
+                            mine.get("timed_dispatches", 0)
+                            + k["timed_dispatches"])
             self.sweeps += snap["sweeps"]
             self.views += snap["views"]
             self.supersteps += snap["supersteps"]
             self.hops += snap["hops"]
             self.peak_rss_bytes = max(self.peak_rss_bytes,
                                       snap["host"]["peak_rss_bytes"])
+            self.peak_device_bytes = max(
+                self.peak_device_bytes,
+                snap["device"].get("peak_device_bytes", 0))
         return self
 
     def finish(self, wall_seconds: float, status: str = "done") -> None:
         """Close the ledger: record wall time, peak RSS, and the explicit
         ``other`` residual phase so queue wait + phase seconds sum to the
         wall time exactly — the invariant /costz consumers rely on."""
+        # one more device-memory read at close (outside the lock: it may
+        # touch the backend) so short queries that never hit a sampled
+        # dispatch still carry a peak-bytes observation where available
+        dev_mem = _device.memory_snapshot()
         with self._lock:
             self.wall_seconds = float(wall_seconds)
             self.status = status
             self.peak_rss_bytes = max(self.peak_rss_bytes,
                                       _rss_peak_bytes())
+            if dev_mem.get("available"):
+                self.peak_device_bytes = max(self.peak_device_bytes,
+                                             dev_mem["bytes_in_use"])
             known = sum(self.phase_seconds.values())
             self.phase_seconds["other"] = max(
                 0.0, self.wall_seconds - self.queue_wait_seconds - known)
@@ -624,6 +761,14 @@ class Ledger:
                                  for k in self.kernels.values()),
                 "est_bytes_accessed": sum(k["est_bytes_accessed"]
                                           for k in self.kernels.values()),
+                # the measured half (obs/device.py): wall seconds of the
+                # sampled timed dispatches + peak observed device bytes
+                "measured_seconds": round(
+                    sum(k.get("measured_seconds", 0.0)
+                        for k in self.kernels.values()), 6),
+                "timed_dispatches": sum(k.get("timed_dispatches", 0)
+                                        for k in self.kernels.values()),
+                "peak_device_bytes": int(self.peak_device_bytes),
                 "kernels": {n: dict(k) for n, k in self.kernels.items()},
             },
             "host": {"peak_rss_bytes": int(self.peak_rss_bytes)},
@@ -716,6 +861,8 @@ def status_block() -> dict:
         "xla": caps,
         "kernels": len(kernels),
         "kernels_by_bound": KernelRegistry.bound_counts(kernels),
+        "kernel_registry_cap": _device.registry_cap(),
+        "kernel_registry_evictions": REGISTRY.evictions,
         "queries_completed": _COMPLETED[0],
     }
 

@@ -283,6 +283,31 @@ class Metrics:
         self.advisor_ticks = Counter(
             "raphtory_advisor_ticks_total",
             "Advisor rule-evaluation passes", registry=r)
+        # device runtime plane (obs/device.py): the MEASURED half of the
+        # ledger — sampled timed-dispatch latencies, observed XLA
+        # compiles (the compile-storm evidence), and device memory
+        self.device_kernel_seconds = Histogram(
+            "raphtory_device_kernel_seconds",
+            "Measured wall seconds of sampled timed dispatches "
+            "(RTPU_DEVICE_TIMING; includes dispatch overhead and the "
+            "sync's pipeline drain)", ["kernel"],
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                     5.0, 30.0, float("inf")), registry=r)
+        self.compiles = Counter(
+            "raphtory_compiles_total",
+            "XLA compiles observed at the kernel registry's "
+            "lower().compile() sites (one per new (kernel, shape-sig))",
+            ["kernel"], registry=r)
+        self.compile_seconds = Counter(
+            "raphtory_compile_seconds_total",
+            "Seconds inside observed XLA compiles, by kernel",
+            ["kernel"], registry=r)
+        self.device_bytes_in_use = Gauge(
+            "raphtory_device_bytes_in_use",
+            "Device bytes in use (memory_stats of device 0; 0 when the "
+            "backend exposes no memory counters — /devicez reports the "
+            "unavailable degrade explicitly)", registry=r)
+        self.device_bytes_in_use.set_function(_device_bytes_in_use)
         # memory governor (Archivist signals)
         self.compactions = Counter(
             "raphtory_compactions_total",
@@ -295,6 +320,19 @@ class Metrics:
             "Host resident set size (the reference's heap gauge)",
             registry=r)
         self.heap_bytes.set_function(_rss_bytes)
+
+
+def _device_bytes_in_use() -> float:
+    """Scrape-time device-memory gauge callback — must never raise (a
+    prometheus scrape is no place for a backend error), so unavailable
+    degrades to 0.0; lazy import keeps metrics importable without the
+    device plane."""
+    try:
+        from .device import gauge_bytes_in_use
+
+        return gauge_bytes_in_use()
+    except Exception:
+        return 0.0
 
 
 def _rss_bytes() -> float:

@@ -243,6 +243,21 @@ def _h2d_totals() -> dict:
     return shared_engine().stats.totals()
 
 
+def _device_bytes_in_use() -> float:
+    """Device-memory occupancy collector (obs/device.py): raises on
+    backends without memory counters so the sample records None — the
+    ring's failing-collector contract, the thread never dies."""
+    from .device import series_bytes_in_use
+
+    return series_bytes_in_use()
+
+
+def _device_resident_bytes() -> float:
+    from .device import RESIDENT
+
+    return float(RESIDENT.snapshot()["total_bytes"])
+
+
 def sparkline(values: list[float]) -> str:
     """Text sparkline over ``values`` (min..max scaled to 8 levels);
     constant series render flat-low."""
@@ -288,6 +303,11 @@ class SeriesRing:
                       lambda: _h2d_totals()["stall_seconds"])
         self.register("h2d_bytes_total",
                       lambda: float(_h2d_totals()["bytes_shipped"]))
+        # device runtime plane (obs/device.py): live memory occupancy
+        # (None on backends without memory_stats — this CPU rig) and
+        # the resident-buffer registry's total
+        self.register("device_bytes_in_use", _device_bytes_in_use)
+        self.register("device_resident_bytes", _device_resident_bytes)
 
     # ---- collectors ----
 
