@@ -2155,6 +2155,43 @@ def _column_layout(hop_times, windows):
     return H, H * len(wlist), hop_of_col, T_col, w_col
 
 
+def stack_grids(grids):
+    """Multi-REQUEST column stacking: merge per-request ``(hop_times,
+    windows)`` grids into ONE dispatch grid — the serving scheduler's
+    entry point into the columnar engines (jobs/scheduler.py).
+
+    Concurrent requests over the same log and algorithm family differ
+    only in WHICH (hop, window) views they want; each view is one column
+    of a columnar dispatch, so the batch grid is simply the cross
+    product of the hop union and the window union — a superset of every
+    member's own grid (extra cells are the coalescing overhead the
+    scheduler's column cap bounds). Returns ``(hops, wlist, cols)``:
+
+    * ``hops`` — ascending union of all hop times (ints, deduplicated);
+    * ``wlist`` — union of the normalized windows (``None`` → -1, the
+      engine convention), first-seen order, deduplicated;
+    * ``cols`` — per request, the flat column indices of ITS cells in
+      the batch result (hop-major ``_column_layout`` order), listed hops
+      ascending × that request's own window order — exactly the order a
+      serial per-request dispatch would have emitted them in, so the
+      demux is an index gather, never a re-sort.
+    """
+    hops = sorted({int(t) for ts, _ in grids for t in ts})
+    wlist: list[int] = []
+    for _, ws in grids:
+        for w in normalize_windows(ws):
+            if w not in wlist:
+                wlist.append(w)
+    W = len(wlist)
+    hop_idx = {t: j for j, t in enumerate(hops)}
+    cols = []
+    for ts, ws in grids:
+        nws = [wlist.index(w) for w in normalize_windows(ws)]
+        cols.append([hop_idx[int(t)] * W + i
+                     for t in sorted({int(x) for x in ts}) for i in nws])
+    return hops, wlist, cols
+
+
 def run_columns(tables, e_lat, e_alive, v_lat, v_alive, hop_times, windows,
                 *, damping: float = 0.85, tol: float = 1e-7,
                 max_steps: int = 20, e_src_dev=None, e_dst_dev=None,

@@ -78,13 +78,35 @@ Query = ViewQuery | RangeQuery | LiveQuery
 class Job:
     def __init__(self, job_id: str, program: VertexProgram, query: Query,
                  graph: TemporalGraph, mesh=None, wait_timeout: float = 30.0,
-                 explain: bool = False, tenant: str | None = None):
+                 explain: bool = False, tenant: str | None = None,
+                 deadline_ms=None, priority: int = 0,
+                 no_batch: bool = False):
         self.id = job_id
         self.program = program
         self.query = query
         self.graph = graph
         self.mesh = mesh
         self.wait_timeout = wait_timeout
+        #: client deadline (jobs/scheduler.py): absolute monotonic
+        #: seconds, or None. An expired-in-queue job fails fast with
+        #: status "expired" and never dispatches.
+        self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
+        self.deadline = (None if deadline_ms is None
+                         else _time.monotonic() + float(deadline_ms) / 1e3)
+        if self.deadline_ms is not None and not self.deadline_ms > 0:
+            raise ValueError(
+                f"deadline_ms must be positive, got {deadline_ms!r}")
+        #: >= scheduler.PRIORITY_BYPASS skips the coalescing collect
+        #: window entirely (latency over throughput)
+        self.priority = int(priority or 0)
+        #: per-request coalescing opt-out (REST `batch: false`)
+        self.no_batch = bool(no_batch)
+        #: _Pending handle while waiting in a scheduler collect window
+        self._coalesce = None
+        #: the manager's ServingScheduler (admission/price hooks); None
+        #: for directly-constructed jobs
+        self._sched = None
+        self._admitted_cost_s = None
         #: per-query resource ledger — always collected (cheap dict
         #: accounting); ``explain`` additionally returns it with the
         #: results over REST (obs/ledger.py)
@@ -223,6 +245,13 @@ class Job:
         # knob so the bench off-arm pays nothing.
         if _advisor.enabled():
             _advisor.note_query(led.as_dict())
+        # serving-scheduler completion hook (jobs/scheduler.py): release
+        # this job's admitted cost from the live backlog and fold its
+        # measured seconds-per-view into the admission price book —
+        # always, whatever the outcome (an admitted job that failed
+        # still left the backlog)
+        if self._sched is not None:
+            self._sched.complete(self)
         if not _ledger.collection_enabled():
             return
         METRICS.query_cost_queries.labels(alg, led.bound()).inc()
@@ -244,6 +273,25 @@ class Job:
     def _run_query(self) -> None:
         try:
             q = self.query
+            if self.deadline is not None \
+                    and _time.monotonic() > self.deadline:
+                # fail fast BEFORE any dispatch: the client has already
+                # given up on this answer (jobs/scheduler.py deadlines)
+                self.status = "expired"
+                self.error = (f"DeadlineExpired: deadline_ms="
+                              f"{self.deadline_ms:g} passed before the "
+                              "job dispatched")
+                if self._coalesce is None:
+                    # a queued job's expiry is counted ONCE, by the
+                    # scheduler at batch formation — counting here too
+                    # would report one expired request as two
+                    from . import scheduler as _sched
+
+                    _sched.note_deadline_expired(self)
+                return
+            if self._coalesce is not None and self._run_coalesced(q):
+                return   # status set by the coalesced path
+            self._coalesce = None   # declined/timed out: own path
             if isinstance(q, ViewQuery):
                 self._run_at(q.timestamp, q)
             elif isinstance(q, RangeQuery):
@@ -324,6 +372,77 @@ class Job:
                     break
             else:
                 self._kill.wait(q.repeat)
+
+    def _run_coalesced(self, q) -> bool:
+        """Wait on this job's scheduler collect-window handle and, when
+        the batch dispatched, demux + emit THIS job's columns on THIS
+        thread (result/ledger ownership never crosses threads). Returns
+        False when the scheduler declined (solo window, incompatible
+        pack, failed dispatch) — the caller falls through to the normal
+        per-job routes, so coalescing can only ever ADD latency equal to
+        the collect window, never lose a request."""
+        pend = self._coalesce
+        limit = max(float(self.wait_timeout), 600.0)
+        w0 = _time.monotonic()
+        while not pend.done.wait(0.05):
+            if self._kill.is_set():
+                self.status = "killed"
+                return True
+            if _time.monotonic() - w0 > limit:
+                _jobs_log.warning(
+                    "coalesced wait timed out for %s after %.0fs — "
+                    "falling back to the solo path", self.id, limit)
+                return False
+        if pend.outcome == "declined":
+            return False
+        if pend.outcome == "killed":
+            self.status = "killed"
+            return True
+        if pend.outcome == "expired":
+            self.status = "expired"
+            self.error = (f"DeadlineExpired: deadline_ms="
+                          f"{self.deadline_ms:g} expired in the "
+                          "scheduler queue (never dispatched)")
+            return True
+        pay = pend.payload
+        # collect-window queueing the scheduler ADDED, measured from
+        # THIS THREAD's wait start (w0) — not pend.enqueued, which
+        # predates the thread and overlaps queue_wait_seconds; the
+        # dispatch itself is attributed by column share via
+        # absorb_share, so queue_wait + sched_wait + phases never
+        # double-count an interval
+        self.ledger.add_phase("sched_wait", max(
+            0.0, pay["dispatch_started"] - w0))
+        self.ledger.absorb_share(pay["snap"], pay["share"],
+                                 coalesced=pay["batch"])
+        self._emit_coalesced(pend.grid, pay)
+        self.status = "done" if not self._kill.is_set() else "killed"
+        return True
+
+    def _emit_coalesced(self, grid, pay) -> None:
+        """Emit this job's result rows from a shared batch dispatch:
+        ``grid`` is the SAME (hops, windows) tuple the scheduler packed
+        this job's columns from (``pend.grid`` — never re-derived, so
+        the demux can't drift from the packing), in serial emission
+        order. ``viewTime`` is the amortised per-column share of the
+        batch dispatch — the same rule ``_emit_columnar`` applies
+        within one job's sweep, extended across requests."""
+        hops, windows = grid
+        ranks, steps = pay["ranks"], int(pay["steps"])
+        shells, cols = pay["shells"], pay["cols"]
+        per_row = pay["elapsed"] / max(pay["total_cols"], 1)
+        for _ in hops:
+            METRICS.snapshot_build_seconds.observe(
+                pay["fold_seconds"] * pay["share"] / max(len(hops), 1))
+        self.ledger.count_supersteps(steps)
+        i = 0
+        for T in sorted({int(t) for t in hops}):
+            for w in windows:
+                if self._kill.is_set():
+                    return
+                self._emit(T, w, ranks[cols[i]], shells[int(T)], steps,
+                           _time.perf_counter() - per_row)
+                i += 1
 
     def _device_engine_ok(self) -> bool:
         """Shared eligibility gate for the device-resident engines (warm
@@ -806,10 +925,17 @@ class AnalysisManager:
 
     def __init__(self, graph: TemporalGraph, mesh=None, sink_dir: str = "",
                  sink_format: str = "jsonl"):
+        from .scheduler import ServingScheduler
+
         self.graph = graph
         self.mesh = mesh
         self.sink_dir = sink_dir       # "" disables file sinks (ref: unset
         self.sink_format = sink_format  # env path in Utils.scala:107-126)
+        #: serving scheduler (jobs/scheduler.py): cross-request
+        #: coalescing collect windows + ledger-priced admission control
+        #: + deadlines. Always constructed — RTPU_BATCH_WINDOW_MS=0 and
+        #: RTPU_ADMISSION=0 make every path identical to pre-scheduler.
+        self.scheduler = ServingScheduler(graph)
         self._jobs: dict[str, Job] = {}
         self._counter = itertools.count()
         self._lock = threading.Lock()
@@ -841,18 +967,44 @@ class AnalysisManager:
                job_id: str | None = None, mesh=None,
                wait_timeout: float = 30.0, sink_name: str | None = None,
                sink_format: str | None = None,
-               explain: bool = False, tenant: str | None = None) -> Job:
+               explain: bool = False, tenant: str | None = None,
+               deadline_ms=None, priority: int = 0,
+               batch=None) -> Job:
         from .sink import ResultSink, resolve_sink_path
 
+        # a malformed deadline is the CALLER's error and must raise as
+        # one — validated BEFORE admission, or an admission-enabled
+        # server would misreport it as a deadline_infeasible shed (a
+        # capacity signal) and pollute the shed metrics
+        if deadline_ms is not None and not float(deadline_ms) > 0:
+            raise ValueError(
+                f"deadline_ms must be positive, got {deadline_ms!r}")
+        # admission BEFORE the job exists: an over-budget / over-share /
+        # deadline-infeasible request is shed here with AdmissionDenied
+        # (REST maps it to 429 + Retry-After) and never touches the job
+        # table. The returned estimate is registered into the live
+        # backlog; complete() (via _publish_ledger) or the failure path
+        # below releases it.
+        est = self.scheduler.admit(program, query, tenant,
+                                   deadline_ms=deadline_ms)
         with self._lock:
             if job_id is None:
                 job_id = f"{type(program).__name__}_{next(self._counter)}"
             if job_id in self._jobs:
+                self.scheduler.cancel(est, tenant)
                 raise KeyError(f"job {job_id!r} already exists")
-            job = Job(job_id, program, query, self.graph,
-                      mesh=mesh if mesh is not None else self.mesh,
-                      wait_timeout=wait_timeout, explain=explain,
-                      tenant=tenant)
+            try:
+                job = Job(job_id, program, query, self.graph,
+                          mesh=mesh if mesh is not None else self.mesh,
+                          wait_timeout=wait_timeout, explain=explain,
+                          tenant=tenant, deadline_ms=deadline_ms,
+                          priority=priority,
+                          no_batch=batch is False)
+            except BaseException:
+                self.scheduler.cancel(est, tenant)
+                raise
+            job._sched = self.scheduler
+            job._admitted_cost_s = est
             self._jobs[job_id] = job
             self._note_table(write=True)
             self._evict_done_locked()
@@ -883,8 +1035,30 @@ class AnalysisManager:
                 sink.close()
             with self._lock:
                 del self._jobs[job_id]
+            self.scheduler.cancel(est, tenant)
             raise
-        return job.start()
+        # coalescing: an eligible job joins its family's collect window
+        # BEFORE its thread starts (the thread's first act is to wait on
+        # the window handle); ineligible jobs — and every job when
+        # RTPU_BATCH_WINDOW_MS=0 — take exactly the pre-scheduler path
+        try:
+            self.scheduler.offer(job)
+            return job.start()
+        except BaseException:
+            # thread exhaustion is exactly when admission matters: a
+            # failed start must not leave a never-running "running" job
+            # in the table nor its cost stuck in the admission backlog.
+            # Kill first: offer() may have enqueued a _Pending, and a
+            # dead job's pending must be dropped at batch formation
+            # (the dispatch loop checks _kill), not dispatched for a
+            # result nobody will read
+            job.kill()
+            if sink is not None:
+                sink.close()
+            with self._lock:
+                self._jobs.pop(job_id, None)
+            self.scheduler.cancel(est, tenant)
+            raise
 
     def get(self, job_id: str) -> Job:
         # under the registry lock like every other table access: a bare

@@ -35,6 +35,15 @@ graded ok|degraded|burning from the ``RTPU_SLO_TARGET`` error budgets
 (obs/budget.py). POST bodies additionally accept ``explain`` (truthy):
 the job's resource ledger rides back with ``/AnalysisResults``.
 
+Serving-scheduler fields (jobs/scheduler.py, docs/SERVING.md): POST
+bodies may carry ``deadline_ms`` (positive number — expired-in-queue
+jobs fail fast with status ``expired``), ``batch`` (boolean; ``false``
+opts out of cross-request coalescing) and ``priority`` (int 0..9; >= 8
+bypasses the collect window). Malformed values 400 via ``_BadParam``.
+With ``RTPU_ADMISSION=1`` an over-budget / over-share /
+deadline-infeasible request is shed with **429** + ``Retry-After`` and
+the evidence (queue depth, priced cost, budget) that justified it.
+
 Every POST runs under a ``rest.request`` span: the span's trace context
 is captured at submit and adopted by the job thread (obs/trace.py), so
 ``/tracez?trace_id=`` reconstructs REST → job → fold workers → transfer
@@ -58,6 +67,7 @@ from ..obs.sampler import SAMPLER
 from ..obs.trace import TRACER, TraceContext
 from ..utils.config import process_index, strided_port
 from . import registry
+from . import scheduler as _scheduler
 from .manager import AnalysisManager, LiveQuery, RangeQuery, ViewQuery
 
 DEFAULT_PORT = 8081
@@ -78,6 +88,62 @@ def _num_param(qs: dict, key: str, default, cast):
         return cast(vals[0])
     except ValueError:
         raise _BadParam(f"{key}={vals[0]!r} is not a number") from None
+
+
+def _body_deadline_ms(body: dict):
+    """Validated ``deadline_ms`` body field: None, or a finite positive
+    number. Anything else — bool, container, NaN, negative — is a
+    malformed CLIENT field and 400s via ``_BadParam`` (never a 500)."""
+    import math as _math
+
+    v = body.get("deadline_ms")
+    if v is None:
+        return None
+    if isinstance(v, bool) or not isinstance(v, (int, float, str)):
+        raise _BadParam(f"deadline_ms={v!r} is not a positive number")
+    try:
+        f = float(v)
+    except ValueError:
+        raise _BadParam(f"deadline_ms={v!r} is not a positive "
+                        "number") from None
+    if not _math.isfinite(f) or f <= 0:
+        raise _BadParam(f"deadline_ms={v!r} must be a finite positive "
+                        "number of milliseconds")
+    return f
+
+
+def _body_priority(body: dict) -> int:
+    """Validated ``priority`` body field: an integer 0..9 (>=8 bypasses
+    the coalescing collect window — jobs/scheduler.py)."""
+    v = body.get("priority")
+    if v is None:
+        return 0
+    if isinstance(v, bool) or not isinstance(v, (int, str)):
+        raise _BadParam(f"priority={v!r} is not an integer 0..9")
+    try:
+        i = int(v)
+    except ValueError:
+        raise _BadParam(f"priority={v!r} is not an integer 0..9") \
+            from None
+    if not 0 <= i <= 9:
+        raise _BadParam(f"priority={i} out of range 0..9")
+    return i
+
+
+def _body_batch(body: dict):
+    """Validated ``batch`` body field: None (default: batchable), or a
+    boolean — ``false`` opts this request out of coalescing."""
+    v = body.get("batch")
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)) and v in (0, 1):
+        return bool(v)
+    if isinstance(v, str) and v.lower() in ("0", "1", "true", "false",
+                                            "yes", "no"):
+        return v.lower() in ("1", "true", "yes")
+    raise _BadParam(f"batch={v!r} is not a boolean")
 
 
 def _compile_cache_sizes() -> dict:
@@ -136,6 +202,10 @@ def _statusz(manager: AnalysisManager,
                         for k, v in g.watermarks.snapshot().items()},
         },
         "transfer": {"depth": eng.depth, **eng.stats.as_dict()},
+        # the serving scheduler (jobs/scheduler.py): queue depth by
+        # class, batches formed, coalesced-jobs histogram, shed and
+        # deadline-expired counters, admission backlog + price book
+        "scheduler": manager.scheduler.status_block(),
         "compile_caches": _compile_cache_sizes(),
         "fold_cache": _fold_cache_status(),
         "trace": TRACER.status(),
@@ -211,11 +281,13 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):  # quiet
         pass
 
-    def _json(self, code: int, payload) -> None:
+    def _json(self, code: int, payload, headers: dict | None = None) -> None:
         data = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(data)
 
@@ -288,11 +360,18 @@ class _Handler(BaseHTTPRequestHandler):
                 # a present-but-blank header (proxy artifacts) must not
                 # suppress the body-field fallback
                 tenant = body.get("tenant")
+            # serving-scheduler fields (jobs/scheduler.py): each is
+            # validated HERE so malformed client values 400 via the
+            # _BadParam path instead of 500ing deep in the jobs layer
+            deadline_ms = _body_deadline_ms(body)
+            priority = _body_priority(body)
+            batch = _body_batch(body)
             job = self.manager.submit(
                 program, q, job_id=body.get("jobID"),
                 sink_name=body.get("sinkName"),
                 sink_format=body.get("sinkFormat"),
-                explain=explain, tenant=tenant)
+                explain=explain, tenant=tenant,
+                deadline_ms=deadline_ms, priority=priority, batch=batch)
             rsp.set(job_id=job.id, tenant=job.tenant)
             payload = {"jobID": job.id, "status": job.status,
                        "tenant": job.tenant}
@@ -307,6 +386,19 @@ class _Handler(BaseHTTPRequestHandler):
             if job.sink is not None:
                 payload["sinkPath"] = job.sink.path
             self._json(200, payload)
+        except _scheduler.AdmissionDenied as e:
+            # a SHED request, not an error: 429 with the Retry-After the
+            # pricing computed and the evidence line (queue depth,
+            # priced cost, budget) that justified it — clients and
+            # operators alike can see WHY, not just that they were told
+            # to go away
+            rsp.set(shed=e.evidence.get("reason"))
+            self._json(
+                429,
+                {"error": f"AdmissionDenied: {e}",
+                 "evidence": e.evidence,
+                 "retryAfterSeconds": e.retry_after_s},
+                headers={"Retry-After": str(int(e.retry_after_s))})
         except (KeyError, ValueError, TypeError) as e:
             self._json(400, {"error": f"{type(e).__name__}: {e}"})
         except Exception as e:  # noqa: BLE001

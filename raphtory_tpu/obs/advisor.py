@@ -233,7 +233,10 @@ def rule_fold_stall_workers(sig: dict) -> dict | None:
 def rule_queue_burn_shed(sig: dict) -> dict | None:
     """Queue wait is material while some SLO budget is burning — the
     admission-control signal pair. Recommends shedding the top-cost
-    tenant BY NAME with its ledger rows as the shed-this evidence."""
+    tenant BY NAME with its ledger rows as the shed-this evidence.
+    Since the serving scheduler landed (jobs/scheduler.py) this
+    recommendation has an actuator: ``RTPU_ADMISSION=1`` sheds exactly
+    this tenant's new requests with 429s while the budget burns."""
     bud = sig.get("budget") or {}
     if bud.get("grade") != "burning":
         return None
@@ -254,9 +257,11 @@ def rule_queue_burn_shed(sig: dict) -> dict | None:
         f"queue-wait p99 {p99:.3f}s while "
         f"{[t['algorithm'] for t in burning]} burn their error budget; "
         f"tenant {top['tenant']!r} holds the top attributed cost",
-        "admission",
-        f"shed tenant {top['tenant']!r} (kill its jobs via /KillTask, "
-        "or rate-limit it upstream) until the fast burn drops below 1",
+        "RTPU_ADMISSION",
+        f"shed tenant {top['tenant']!r}: set RTPU_ADMISSION=1 so the "
+        "serving scheduler sheds its new requests with 429s "
+        "automatically (jobs/scheduler.py), or kill its jobs via "
+        "/KillTask until the fast burn drops below 1",
         {"queue_wait_p99_seconds": round(p99, 4),
          "burning_targets": burning,
          "top_tenant": {

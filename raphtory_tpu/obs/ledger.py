@@ -526,6 +526,11 @@ class Ledger:
         #: max device bytes-in-use observed at sampled timed dispatches
         #: (+ one read at finish) — 0 on backends without memory_stats
         self.peak_device_bytes = 0
+        #: set by the serving scheduler when this query's views rode a
+        #: COALESCED cross-request dispatch (jobs/scheduler.py): batch
+        #: id, member count, this query's column share — the explain
+        #: surface's proof of which batch served it
+        self.coalesced: dict | None = None
 
     # ---- recording ----
 
@@ -683,6 +688,56 @@ class Ledger:
                 snap["device"].get("peak_device_bytes", 0))
         return self
 
+    def absorb_share(self, batch_snap: dict, frac: float,
+                     coalesced: dict | None = None) -> None:
+        """Fold THIS query's share of a coalesced batch dispatch's
+        accounting in (``batch_snap`` = the batch ledger's ``as_dict()``,
+        ``frac`` = this query's columns / the batch's total columns —
+        the scheduler's attribution rule). Divisible resources (phase
+        seconds, H2D bytes, estimated FLOPs/bytes) scale by ``frac`` so
+        the members' ledgers SUM to the batch's cost; per-rider counts
+        (kernel dispatches, sweeps) land whole — every member's views
+        did ride that one dispatch. The batch's ``other`` residual is
+        skipped: each member computes its own residual at finish()."""
+        frac = float(frac)
+        with self._lock:
+            for ph, sec in batch_snap["phase_seconds"].items():
+                if ph == "other":
+                    continue
+                self.phase_seconds[ph] = (
+                    self.phase_seconds.get(ph, 0.0) + sec * frac)
+            for mode, sec in batch_snap["fold"]["seconds_by_mode"].items():
+                self.fold_mode_seconds[mode] = (
+                    self.fold_mode_seconds.get(mode, 0.0) + sec * frac)
+            # the batch's ONE fold outcome is every member's outcome: a
+            # hit means this query skipped folding too
+            self.fold_cache_hits += batch_snap["fold"]["cache_hits"]
+            self.fold_cache_misses += batch_snap["fold"]["cache_misses"]
+            self.h2d_bytes += int(batch_snap["h2d"]["bytes"] * frac)
+            for stage, sec in batch_snap["h2d"]["stall_seconds"].items():
+                self.h2d_stall_seconds[stage] = (
+                    self.h2d_stall_seconds.get(stage, 0.0) + sec * frac)
+            for name, k in batch_snap["device"]["kernels"].items():
+                mine = self.kernels.get(name)
+                if mine is None:
+                    mine = self.kernels[name] = {
+                        "dispatches": 0, "est_flops": 0.0,
+                        "est_bytes_accessed": 0.0, "est_hbm_bytes": 0.0,
+                        "bound": "unknown"}
+                mine["dispatches"] += k["dispatches"]
+                mine["est_flops"] += k["est_flops"] * frac
+                mine["est_bytes_accessed"] += (
+                    k["est_bytes_accessed"] * frac)
+                mine["est_hbm_bytes"] = (
+                    mine.get("est_hbm_bytes", 0.0)
+                    + k.get("est_hbm_bytes", 0.0) * frac)
+                mine["bound"] = k.get("bound", "unknown")
+                if k.get("bound_refined"):
+                    mine["bound_refined"] = k["bound_refined"]
+            self.sweeps += 1
+            if coalesced is not None:
+                self.coalesced = dict(coalesced)
+
     def finish(self, wall_seconds: float, status: str = "done") -> None:
         """Close the ledger: record wall time, peak RSS, and the explicit
         ``other`` residual phase so queue wait + phase seconds sum to the
@@ -776,6 +831,8 @@ class Ledger:
             "views": self.views,
             "supersteps": self.supersteps,
             "hops": self.hops,
+            **({"coalesced": dict(self.coalesced)}
+               if self.coalesced is not None else {}),
         }
 
     def as_dict(self) -> dict:

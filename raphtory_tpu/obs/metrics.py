@@ -275,6 +275,38 @@ class Metrics:
             "Fraction of the error budget left over this process's "
             "lifetime (1.0 = untouched, 0 = exhausted, negative = "
             "overspent)", ["algorithm"], registry=r)
+        # serving scheduler (jobs/scheduler.py): cross-request
+        # coalescing + ledger-priced admission control + deadlines.
+        # Label cardinality is bounded: family comes from the fixed
+        # columnar-engine set, reason from the fixed shed-rule set.
+        self.scheduler_batches = Counter(
+            "raphtory_scheduler_batches_total",
+            "Coalesced cross-request batches dispatched by the serving "
+            "scheduler, by algorithm family", ["family"], registry=r)
+        self.scheduler_coalesced_jobs = Histogram(
+            "raphtory_scheduler_coalesced_jobs",
+            "Jobs per coalesced batch dispatch (the amortisation "
+            "factor)", buckets=(1, 2, 4, 8, 16, 32, 64, 128,
+                                float("inf")), registry=r)
+        self.scheduler_shed = Counter(
+            "raphtory_scheduler_shed_total",
+            "Requests shed by admission control (HTTP 429), by reason "
+            "(queue_full, tenant_share, shed_top_tenant, over_budget, "
+            "deadline_infeasible)", ["reason"], registry=r)
+        self.scheduler_deadline_expired = Counter(
+            "raphtory_scheduler_deadline_expired_total",
+            "Jobs whose deadline_ms expired before dispatch (failed "
+            "fast; never reached the device)", registry=r)
+        self.scheduler_queue_depth = Gauge(
+            "raphtory_scheduler_queue_depth",
+            "Jobs currently waiting in serving-scheduler collect "
+            "windows, summed over live schedulers", registry=r)
+        self.scheduler_queue_depth.set_function(_scheduler_queue_depth)
+        self.scheduler_backlog_seconds = Gauge(
+            "raphtory_scheduler_backlog_seconds",
+            "Ledger-priced cost seconds admitted but not yet completed "
+            "(the admission-control pressure signal)", registry=r)
+        self.scheduler_backlog_seconds.set_function(_scheduler_backlog)
         # advisor plane (obs/advisor.py): strictly read-only findings
         self.advisor_findings = Gauge(
             "raphtory_advisor_findings",
@@ -320,6 +352,27 @@ class Metrics:
             "Host resident set size (the reference's heap gauge)",
             registry=r)
         self.heap_bytes.set_function(_rss_bytes)
+
+
+def _scheduler_queue_depth() -> float:
+    """Scrape-time gauge callback over the live serving schedulers —
+    must never raise; lazy import keeps metrics importable without the
+    jobs layer."""
+    try:
+        from ..jobs.scheduler import total_queue_depth
+
+        return total_queue_depth()
+    except Exception:
+        return 0.0
+
+
+def _scheduler_backlog() -> float:
+    try:
+        from ..jobs.scheduler import total_backlog_seconds
+
+        return total_backlog_seconds()
+    except Exception:
+        return 0.0
 
 
 def _device_bytes_in_use() -> float:

@@ -340,6 +340,24 @@ class SeriesRing:
 
         self.register("jobs_in_flight", lambda: _count(("running",)))
         self.register("jobs_queued", lambda: _count(("pending",)))
+        # serving-scheduler signals (jobs/scheduler.py): collect-window
+        # queue depth + ledger-priced admitted backlog — the saturation
+        # shape a coalescing storm is diagnosed with at /slz
+        sched = getattr(manager, "scheduler", None)
+        if sched is not None:
+            sref = weakref.ref(sched)
+
+            def _sched_depth():
+                s = sref()
+                return float(s.queue_depth()) if s is not None else 0.0
+
+            def _sched_backlog():
+                s = sref()
+                return (float(s.backlog_seconds())
+                        if s is not None else 0.0)
+
+            self.register("scheduler_queue_depth", _sched_depth)
+            self.register("scheduler_backlog_seconds", _sched_backlog)
 
     # ---- sampling ----
 

@@ -1,0 +1,510 @@
+"""Serving scheduler (jobs/scheduler.py): cross-request coalescing
+equivalence, admission control, deadlines, REST hardening.
+
+Equivalence contract (the columnar engines' established rule,
+docs/SERVING.md): CC and BFS are integer/min-plus kernels — coalesced
+results are BITWISE equal to serial scheduler-off submission; PageRank
+is an f32 fixed-point solver whose differently-shaped batch programs
+may round reductions differently, so it agrees to solver tolerance.
+``steps`` reports the SHARED dispatch's superstep count for coalesced
+rows and is excluded from row comparison alongside ``viewTime``.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raphtory_tpu.core.service import TemporalGraph
+from raphtory_tpu.jobs import registry
+from raphtory_tpu.jobs.manager import (AnalysisManager, LiveQuery,
+                                       RangeQuery, ViewQuery)
+from raphtory_tpu.jobs.rest import RestServer
+from raphtory_tpu.jobs.scheduler import (AdmissionDenied, family_of,
+                                         request_grid)
+
+
+def _graph(seed=7, n_events=600, n_ids=40, t_span=60):
+    from test_sweep import random_log
+
+    rng = np.random.default_rng(seed)
+    return TemporalGraph(random_log(rng, n_events=n_events, n_ids=n_ids,
+                                    t_span=t_span))
+
+
+def _wait_done(jobs, timeout=300):
+    for j in jobs:
+        assert j.wait(timeout), f"{j.id} never finished"
+        assert j.status == "done", (j.id, j.status, j.error)
+
+
+def _rows(job):
+    """Result rows minus the timing/steps columns (viewTime is wall
+    time; steps reports the shared dispatch's count on coalesced rows)."""
+    return [{k: v for k, v in r.items() if k not in ("viewTime", "steps")}
+            for r in job.results]
+
+
+def _approx_pr_rows(got, want):
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert (g["time"], g["windowsize"]) == (w["time"], w["windowsize"])
+        assert g["result"]["sum"] == pytest.approx(w["result"]["sum"],
+                                                   abs=1e-4)
+        rg, rw = dict(g["result"]["top10"]), dict(w["result"]["top10"])
+        assert set(rg) == set(rw)
+        for k in rg:
+            assert rg[k] == pytest.approx(rw[k], abs=1e-5)
+
+
+_CASES = [
+    ("cc", lambda: registry.resolve("ConnectedComponents",
+                                    {"max_steps": 60})),
+    ("bfs", lambda: registry.resolve(
+        "BFS", {"seeds": (0, 1), "directed": False, "max_steps": 50})),
+    ("pagerank", lambda: registry.resolve("PageRank",
+                                          {"max_steps": 30})),
+]
+
+
+@pytest.mark.parametrize("fam,make", _CASES, ids=[c[0] for c in _CASES])
+def test_coalesced_equals_serial_submission(monkeypatch, fam, make):
+    """N compatible concurrent requests coalesce into ONE shared
+    columnar dispatch whose demuxed per-request results equal serial
+    (scheduler-off) submission — bitwise for CC/BFS, solver tolerance
+    for PageRank — over an adversarial delete/tombstone log with mixed
+    windows, two tenants sharing the fold while their ledgers stay
+    isolated."""
+    g = _graph()
+    queries = [
+        (RangeQuery(20, 60, 20, windows=(100, 25)), "acme"),
+        (RangeQuery(40, 60, 10, window=30), "zenith"),
+        (ViewQuery(55, windows=(100, 25)), "acme"),
+        (ViewQuery(60, window=None), "zenith"),
+    ]
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "60")
+    mgr = AnalysisManager(g)
+    jobs = [mgr.submit(make(), q, tenant=t) for q, t in queries]
+    _wait_done(jobs)
+    # all four rode ONE batch (the 60 ms window comfortably collects a
+    # same-thread submission burst)
+    co = [j.ledger.coalesced for j in jobs]
+    assert all(c is not None for c in co), co
+    assert len({c["batch_id"] for c in co}) == 1, co
+    assert co[0]["jobs"] == 4
+    # ledger isolation: each job's ledger carries ITS tenant, and the
+    # shared phase seconds were split by column share (shares sum to <=1)
+    assert [j.ledger.tenant for j in jobs] == [t for _, t in queries]
+    assert sum(c["share"] for c in co) <= 1.0 + 1e-9
+    blk = mgr.scheduler.status_block()
+    assert blk["batches_formed"] >= 1
+    assert blk["coalesced_jobs_hist"], blk
+
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "0")
+    mgr2 = AnalysisManager(g)
+    for j, (q, t) in zip(jobs, queries):
+        ref = mgr2.submit(make(), q, tenant=t)
+        _wait_done([ref])
+        assert ref.ledger.coalesced is None
+        if fam == "pagerank":
+            _approx_pr_rows(_rows(j), _rows(ref))
+        else:
+            assert _rows(j) == _rows(ref)
+
+
+def test_identical_requests_split_their_shared_column(monkeypatch):
+    """Two IDENTICAL concurrent requests share one column — each must
+    absorb HALF the batch's cost, not 100% (absorb_share's conservation
+    contract: member shares sum to <= 1)."""
+    g = _graph(seed=21, n_events=300)
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "60")
+    mgr = AnalysisManager(g)
+    jobs = [mgr.submit(registry.resolve("ConnectedComponents",
+                                        {"max_steps": 60}),
+                       ViewQuery(50, window=30), tenant=t)
+            for t in ("acme", "zenith")]
+    _wait_done(jobs)
+    co = [j.ledger.coalesced for j in jobs]
+    assert all(c is not None for c in co), co
+    assert co[0]["batch_id"] == co[1]["batch_id"]
+    assert co[0]["total_columns"] == 1
+    assert sum(c["share"] for c in co) == pytest.approx(1.0)
+    assert all(c["share"] == pytest.approx(0.5) for c in co), co
+    # results identical, of course
+    assert _rows(jobs[0]) == _rows(jobs[1])
+
+
+def test_clear_stats_resets_counters_not_prices(monkeypatch):
+    g = _graph(seed=22, n_events=300)
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "0")
+    mgr = AnalysisManager(g)
+    job = mgr.submit(registry.resolve("ConnectedComponents"),
+                     ViewQuery(50))
+    _wait_done([job])
+    blk = mgr.scheduler.status_block()
+    assert blk["prices_seconds_per_view"], blk
+    mgr.scheduler.clear_stats()
+    blk = mgr.scheduler.status_block()
+    assert blk["batches_formed"] == 0 and blk["shed"] == {}
+    # the learned price book survives a counter reset
+    assert blk["prices_seconds_per_view"], blk
+
+
+def test_two_tenants_share_fold_cache_with_isolated_accounts(monkeypatch):
+    """A repeat of the same coalesced grid serves its fold from the
+    content-addressed cross-request fold cache — shared across tenants —
+    while each tenant's workload account and SLO exemplars stay its own."""
+    from raphtory_tpu.obs import slo as _slo
+    from raphtory_tpu.obs import workload as _workload
+
+    g = _graph(seed=11)
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "60")
+    _workload.WORKLOAD.clear()
+    _slo.SLO.clear()
+    mgr = AnalysisManager(g)
+
+    def burst():
+        jobs = [
+            mgr.submit(registry.resolve("ConnectedComponents",
+                                        {"max_steps": 60}),
+                       RangeQuery(30, 60, 15, window=40), tenant="acme"),
+            mgr.submit(registry.resolve("ConnectedComponents",
+                                        {"max_steps": 60}),
+                       ViewQuery(45, window=40), tenant="zenith"),
+        ]
+        _wait_done(jobs)
+        return jobs
+
+    first = burst()
+    assert all(j.ledger.coalesced for j in first)
+    second = burst()
+    assert all(j.ledger.coalesced for j in second)
+    # round 2's batch folded nothing: the cache hit is visible in every
+    # member's ledger (tenants SHARE fold work, by design)
+    assert all(j.ledger.fold_cache_hits >= 1 for j in second), \
+        [(j.ledger.fold_cache_hits, j.ledger.fold_cache_misses)
+         for j in second]
+    accounts = _workload.WORKLOAD.workloadz()["tenants"]
+    by_name = {a["tenant"]: a for a in accounts}
+    assert by_name["acme"]["queries_total"] == 2
+    assert by_name["zenith"]["queries_total"] == 2
+    # each account charged a share, not the whole batch
+    assert by_name["acme"]["cost_seconds"] > 0
+    assert by_name["zenith"]["cost_seconds"] > 0
+
+
+def test_window_zero_is_passthrough(monkeypatch):
+    """RTPU_BATCH_WINDOW_MS=0 restores today's behaviour exactly: no job
+    ever enters a collect window."""
+    g = _graph(seed=3, n_events=300)
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "0")
+    mgr = AnalysisManager(g)
+    jobs = [mgr.submit(registry.resolve("ConnectedComponents"),
+                       ViewQuery(t, window=30)) for t in (40, 50, 60)]
+    _wait_done(jobs)
+    assert all(j._coalesce is None for j in jobs)
+    assert all(j.ledger.coalesced is None for j in jobs)
+    blk = mgr.scheduler.status_block()
+    assert blk["enabled"] is False
+    assert blk["batches_formed"] == 0
+
+
+def test_solo_window_declines_to_normal_path(monkeypatch):
+    """A window that collects ONE job declines — the solo path behaves
+    exactly as pre-scheduler (no shared dispatch, no coalesced block)."""
+    g = _graph(seed=5, n_events=300)
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "20")
+    mgr = AnalysisManager(g)
+    job = mgr.submit(registry.resolve("ConnectedComponents"),
+                     ViewQuery(50, window=30))
+    _wait_done([job])
+    assert job.ledger.coalesced is None
+    assert mgr.scheduler.status_block()["solo_passthrough"] >= 1
+
+
+def test_deadline_expired_never_dispatches(monkeypatch):
+    """An expired deadline fails the job fast with status `expired` and
+    zero result rows — before any dispatch."""
+    g = _graph(seed=4, n_events=300)
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "0")
+    mgr = AnalysisManager(g)
+    job = mgr.submit(registry.resolve("ConnectedComponents"),
+                     ViewQuery(50), deadline_ms=0.001)
+    assert job.wait(30)
+    assert job.status == "expired"
+    assert "DeadlineExpired" in job.error
+    assert job.results == []
+    assert mgr.scheduler.status_block()["deadline_expired"] >= 1
+
+
+def test_deadline_expired_in_scheduler_queue(monkeypatch):
+    """A job whose deadline passes while it waits in a collect window is
+    dropped at batch formation — outcome `expired`, never dispatched."""
+    from raphtory_tpu.jobs import scheduler as _sched
+
+    g = _graph(seed=4, n_events=300)
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "40")
+    mgr = AnalysisManager(g)
+    sched = mgr.scheduler
+    job = mgr.submit(registry.resolve("ConnectedComponents"),
+                     ViewQuery(50, window=30), deadline_ms=10_000)
+    grid = request_grid(job.query)
+    pend = _sched._Pending(job, grid)
+    pend.deadline = time.monotonic() - 1.0   # already past
+    before = sched.status_block()["deadline_expired"]
+    sched._dispatch((family_of(job.program)), [pend])
+    assert pend.outcome == "expired"
+    assert sched.status_block()["deadline_expired"] == before + 1
+    _wait_done([job])   # the real job ran normally
+
+
+def test_tight_deadline_never_batched(monkeypatch):
+    """A deadline tighter than the collect window bypasses coalescing —
+    the scheduler never parks a tight-deadline job behind the window."""
+    g = _graph(seed=4, n_events=300)
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "200")
+    mgr = AnalysisManager(g)
+    job = mgr.submit(registry.resolve("ConnectedComponents"),
+                     ViewQuery(50, window=30), deadline_ms=150)
+    assert job._coalesce is None   # declined the window, not expired
+    assert job.wait(60)
+    assert job.status == "done", job.error
+
+
+def test_batch_false_and_priority_bypass(monkeypatch):
+    g = _graph(seed=4, n_events=300)
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "50")
+    mgr = AnalysisManager(g)
+    j1 = mgr.submit(registry.resolve("ConnectedComponents"),
+                    ViewQuery(50, window=30), batch=False)
+    j2 = mgr.submit(registry.resolve("ConnectedComponents"),
+                    ViewQuery(55, window=30), priority=9)
+    assert j1._coalesce is None and j2._coalesce is None
+    _wait_done([j1, j2])
+
+
+def test_live_and_mesh_queries_pass_through(monkeypatch):
+    g = _graph(seed=4, n_events=300)
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "50")
+    mgr = AnalysisManager(g)
+    job = mgr.submit(registry.resolve("DegreeBasic"),
+                     LiveQuery(repeat=5, max_runs=1))
+    assert job._coalesce is None
+    _wait_done([job])
+
+
+def test_admission_storm_keeps_tables_bounded(monkeypatch):
+    """Synthetic storm with admission ON: over-budget requests shed with
+    evidence, the job table stays bounded, /healthz stays out of
+    `burning`."""
+    from raphtory_tpu.obs import budget as _budget
+
+    g = _graph(seed=9, n_events=300)
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "5")
+    monkeypatch.setenv("RTPU_ADMISSION", "1")
+    monkeypatch.setenv("RTPU_ADMISSION_MAX_JOBS", "8")
+    monkeypatch.setenv("RTPU_ADMISSION_BUDGET_S", "2")
+    monkeypatch.setenv("RTPU_JOB_TABLE_CAP", "64")
+    monkeypatch.setenv("RTPU_SLO_TARGET", "ConnectedComponents=p99:120s")
+    _budget.BUDGET.clear()
+    mgr = AnalysisManager(g)
+    jobs, sheds = [], []
+    for i in range(120):
+        try:
+            jobs.append(mgr.submit(
+                registry.resolve("ConnectedComponents", {"max_steps": 40}),
+                ViewQuery(40 + (i % 3) * 10, window=30),
+                tenant=f"t{i % 4}"))
+        except AdmissionDenied as e:
+            sheds.append(e)
+    for j in jobs:
+        j.wait(300)
+    assert sheds, "storm never shed under a 2s budget"
+    e = sheds[-1]
+    assert e.retry_after_s >= 1.0
+    for key in ("reason", "queue_depth", "priced_cost_seconds",
+                "backlog_seconds", "budget_seconds"):
+        assert key in e.evidence, e.evidence
+    with mgr._lock:
+        assert len(mgr._jobs) <= 64
+    code, payload = _budget.healthz()
+    assert payload["status"] != "burning", payload
+    blk = mgr.scheduler.status_block()
+    assert sum(blk["shed"].values()) == len(sheds)
+    # backlog drained once everything finished
+    assert blk["admitted_live_jobs"] == 0, blk
+
+
+def test_admission_tenant_share(monkeypatch):
+    """One tenant cannot hold more than its bounded share of the
+    admitted-job cap while its jobs are live."""
+    g = _graph(seed=9, n_events=300)
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "0")
+    monkeypatch.setenv("RTPU_ADMISSION", "1")
+    monkeypatch.setenv("RTPU_ADMISSION_MAX_JOBS", "4")
+    monkeypatch.setenv("RTPU_SCHED_TENANT_SHARE", "0.5")
+    monkeypatch.setenv("RTPU_ADMISSION_BUDGET_S", "600")
+    mgr = AnalysisManager(g)
+    live = [mgr.submit(registry.resolve("DegreeBasic"),
+                       LiveQuery(repeat=0.2), tenant="acme")
+            for _ in range(2)]
+    try:
+        with pytest.raises(AdmissionDenied) as ei:
+            mgr.submit(registry.resolve("DegreeBasic"),
+                       LiveQuery(repeat=0.2), tenant="acme")
+        assert ei.value.evidence["reason"] == "tenant_share"
+        # another tenant still gets in
+        other = mgr.submit(registry.resolve("ConnectedComponents"),
+                           ViewQuery(50), tenant="zenith")
+        other.wait(120)
+    finally:
+        for j in live:
+            j.kill()
+        for j in live:
+            j.wait(30)
+
+
+# ---------------------------------------------------------------- REST
+
+
+@pytest.fixture()
+def server(monkeypatch):
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "5")
+    g = _graph(seed=2, n_events=300)
+    mgr = AnalysisManager(g)
+    srv = RestServer(mgr, port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _post_raw(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req)
+
+
+@pytest.mark.parametrize("field,value", [
+    ("deadline_ms", "soon"), ("deadline_ms", -5), ("deadline_ms", {"x": 1}),
+    ("deadline_ms", True),
+    ("priority", "urgent"), ("priority", 42), ("priority", [1]),
+    ("batch", "maybe"), ("batch", {"x": 1}), ("batch", 7),
+])
+def test_rest_malformed_scheduler_fields_400(server, field, value):
+    body = {"analyserName": "ConnectedComponents", "timestamp": 50,
+            field: value}
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post_raw(server.port, "/ViewAnalysisRequest", body)
+    assert ei.value.code == 400, ei.value.code
+    err = json.loads(ei.value.read())["error"]
+    assert field in err, err
+
+
+def test_rest_valid_scheduler_fields_accepted(server):
+    with _post_raw(server.port, "/ViewAnalysisRequest", {
+            "analyserName": "ConnectedComponents", "timestamp": 50,
+            "deadline_ms": 60_000, "priority": 3, "batch": True}) as r:
+        out = json.loads(r.read())
+    assert "jobID" in out
+    # drain before teardown: a job (or batch thread) still inside an
+    # XLA dispatch at interpreter exit can abort teardown in C++
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        res = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/AnalysisResults?jobID="
+            f"{out['jobID']}", timeout=10).read())
+        if res["status"] in ("done", "failed", "expired"):
+            break
+        time.sleep(0.05)
+    assert res["status"] == "done", res
+
+
+def test_rest_shed_is_429_with_retry_after_and_evidence(server,
+                                                        monkeypatch):
+    monkeypatch.setenv("RTPU_ADMISSION", "1")
+    # budget clamps at its 0.1s floor; 9 views x the 0.05s default
+    # price (0.45s) prices above it
+    monkeypatch.setenv("RTPU_ADMISSION_BUDGET_S", "0.1")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post_raw(server.port, "/RangeAnalysisRequest",
+                  {"analyserName": "ConnectedComponents",
+                   "start": 20, "end": 60, "jump": 20,
+                   "windowType": "batched", "windowSet": [100, 25, 10]})
+    assert ei.value.code == 429
+    assert int(ei.value.headers["Retry-After"]) >= 1
+    body = json.loads(ei.value.read())
+    assert "AdmissionDenied" in body["error"]
+    ev = body["evidence"]
+    assert ev["reason"] == "over_budget"
+    for key in ("queue_depth", "priced_cost_seconds", "budget_seconds"):
+        assert key in ev
+
+
+def test_statusz_scheduler_block_and_metrics(server):
+    from prometheus_client import generate_latest
+
+    from raphtory_tpu.obs.metrics import METRICS
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/statusz") as r:
+        status = json.loads(r.read())
+    blk = status["scheduler"]
+    for key in ("enabled", "window_ms", "admission", "queue_depth",
+                "queue_by_class", "batches_formed",
+                "coalesced_jobs_hist", "shed", "deadline_expired",
+                "backlog_seconds", "prices_seconds_per_view"):
+        assert key in blk, key
+    text = generate_latest(METRICS.registry).decode()
+    for name in ("raphtory_scheduler_batches_total",
+                 "raphtory_scheduler_coalesced_jobs",
+                 "raphtory_scheduler_shed_total",
+                 "raphtory_scheduler_deadline_expired_total",
+                 "raphtory_scheduler_queue_depth",
+                 "raphtory_scheduler_backlog_seconds"):
+        assert name in text, name
+
+
+def test_concurrent_storm_coalesces_and_matches(monkeypatch):
+    """Many concurrent clients over one graph: scheduler-on forms real
+    batches and every demuxed result equals the scheduler-off rerun of
+    the same request (CC — bitwise)."""
+    g = _graph(seed=13)
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "25")
+    mgr = AnalysisManager(g)
+    reqs = [(ViewQuery(40 + 2 * (i % 8), window=35), f"t{i % 3}")
+            for i in range(24)]
+    jobs = [None] * len(reqs)
+
+    def client(i):
+        q, t = reqs[i]
+        jobs[i] = mgr.submit(registry.resolve(
+            "ConnectedComponents", {"max_steps": 60}), q, tenant=t)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    _wait_done(jobs)
+    blk = mgr.scheduler.status_block()
+    assert blk["batches_formed"] >= 1
+    assert blk["jobs_coalesced"] >= 2
+    monkeypatch.setenv("RTPU_BATCH_WINDOW_MS", "0")
+    mgr2 = AnalysisManager(g)
+    # one serial reference per distinct request shape
+    refs = {}
+    for q, _ in reqs:
+        key = (q.timestamp, q.window)
+        if key not in refs:
+            ref = mgr2.submit(registry.resolve(
+                "ConnectedComponents", {"max_steps": 60}), q)
+            _wait_done([ref])
+            refs[key] = _rows(ref)
+    for j, (q, _) in zip(jobs, reqs):
+        assert _rows(j) == refs[(q.timestamp, q.window)]
