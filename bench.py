@@ -781,6 +781,143 @@ def bench_ingest_sustained():
     }
 
 
+def bench_ingest_obs_overhead():
+    """Freshness-plane overhead on the sustained ingest path — the
+    ISSUE-15 proof row (acceptance: ≤ 5% with the FULL plane on).
+
+    The timed unit is a full pipeline drain (columnar parse → append →
+    per-batch watermark advance) of a RandomSource stream with a
+    tombstone-heavy mix, so every freshness hook is inside the measured
+    window: per-batch op-mix/out-of-orderness accounting, the pending
+    queryable records, and the safe-time drain on every watermark
+    advance. Direct (unstaged) sink mode: the hooks are IDENTICAL in
+    staged mode (the stamp happens at the sink either way — regression-
+    tested), but the staged writer thread makes a 2-core shared box's
+    numbers hostage to scheduler drift (±20pp observed) and this row
+    must resolve a ≤5% budget. On arm = RTPU_FRESH=1 (default), off
+    arm = RTPU_FRESH=0 (observation silenced entirely). Interleaved
+    ABBA pairs judged on the MEDIAN per-pair updates/s ratio (the
+    shared-box protocol: alternating arm order biases drift both ways
+    instead of reading it as overhead). RTPU_BENCH_CHEAP=1 shrinks the
+    stream for CI (`ingest_obs_overhead_cheap`, its own perfwatch
+    series — the seed harness ROADMAP item 3's `live_stream` headline
+    will grow from)."""
+    from raphtory_tpu.core.service import TemporalGraph
+    from raphtory_tpu.ingestion.parser import IdentityParser
+    from raphtory_tpu.ingestion.pipeline import IngestionPipeline
+    from raphtory_tpu.ingestion.source import RandomSource
+    from raphtory_tpu.obs.freshness import FRESH
+
+    cheap = os.environ.get("RTPU_BENCH_CHEAP", "0") not in ("", "0")
+    # the timed unit must outlast the shared box's drift bursts
+    # (sub-second units read pure noise — the BENCH_r12 protocol note):
+    # the columnar staged pipeline sustains ~7M updates/s on this
+    # 2-core box, so these sizes give ~1s (cheap) / ~3s (full) per run
+    n_events = 5_000_000 if cheap else 20_000_000
+    pairs = 7 if cheap else 5
+    # the §6.1 worst-case-shaped mix: deletes exercise the tombstone
+    # accounting, not just the add-only fast path
+    mix = (0.25, 0.55, 0.05, 0.15)
+    saved = os.environ.get("RTPU_FRESH")
+
+    def arm(on: bool):
+        os.environ["RTPU_FRESH"] = "1" if on else "0"
+
+    def one_run(seed: int) -> float:
+        import gc
+
+        # fresh plane state per run: each run's stream restarts event
+        # time at 0, and a stale cross-run high water would misread the
+        # whole stream as out-of-order (different work per pair)
+        FRESH.clear()
+        src = RandomSource(n_events, id_pool=500_000, seed=seed, mix=mix)
+        g = TemporalGraph()
+        pipe = IngestionPipeline(g.log, watermarks=g.watermarks)
+        pipe.add_source(src, IdentityParser())
+        # GC-quiesce: the previous run's dropped multi-hundred-MB log
+        # must not bill its collection to this run (bench._best_of's
+        # established protocol)
+        gc.collect()
+        t0 = _time.perf_counter()
+        pipe.run()
+        dt = _time.perf_counter() - t0
+        if pipe.errors:
+            raise RuntimeError(f"ingest errors: {pipe.errors}")
+        return pipe.counts[src.name] / dt
+
+    def once(seed: int) -> float:
+        # best-of-2 per arm leg: a shared-box hiccup can only LOWER
+        # throughput — the max is the cleaner estimate of the arm's
+        # capability
+        return max(one_run(seed), one_run(seed))
+
+    try:
+        arm(True)
+        once(0)                      # warm: allocator + generator, untimed
+        ab = []
+        for i in range(pairs):
+            # ABBA: alternate which arm leads — monotonic drift then
+            # biases half the pairs each way
+            order = (False, True) if i % 2 == 0 else (True, False)
+            r = {}
+            for on in order:
+                arm(on)
+                r[on] = once(i + 1)   # same seed per pair: identical work
+            ab.append((r[False], r[True]))   # (off_ups, on_ups)
+        arm(True)
+        fresh_snapshot = FRESH.status_block()
+    finally:
+        if saved is None:
+            os.environ.pop("RTPU_FRESH", None)
+        else:
+            os.environ["RTPU_FRESH"] = saved
+
+    # throughputs: ratio > 1 means the plane SLOWED ingest
+    ratios = sorted(off / on for off, on in ab)
+    median = ratios[len(ratios) // 2] if len(ratios) % 2 \
+        else (ratios[len(ratios) // 2 - 1] + ratios[len(ratios) // 2]) / 2
+    off_max = max(off for off, _ in ab)
+    on_max = max(on for _, on in ab)
+    return {
+        "config": ("ingest_obs_overhead_cheap" if cheap
+                   else "ingest_obs_overhead"),
+        "metric": ("freshness-plane overhead on sustained columnar "
+                   "ingest (per-source telemetry + out-of-orderness + "
+                   "queryable tracking on vs RTPU_FRESH=0, "
+                   + (f"CI cheap {n_events // 10**6}M-event stream)"
+                      if cheap else
+                      f"{n_events // 10**6}M-event worst-case-mix "
+                      "stream)")),
+        "value": round((median - 1.0) * 100.0, 2),
+        "unit": "percent_slower_with_freshness",
+        "detail": {
+            "n_events": n_events,
+            "mix": list(mix),
+            "engine": "pipeline_columnar_direct (parse → append → "
+                      "per-batch watermark advance; staged-mode hooks "
+                      "identical, regression-tested)",
+            "cheap_mode": cheap,
+            "timing": ("interleaved_ABBA_pairs_median_ratio_best_of_2 — "
+                       "per-pair off/on updates-per-second ratios, same "
+                       "seed inside each pair so both arms stream "
+                       "identical events; each leg is best-of-2 (a "
+                       "2-core scheduler hiccup can only LOWER "
+                       "throughput)"),
+            "pairs_updates_per_s": [[round(a, 1), round(b, 1)]
+                                    for a, b in ab],
+            "per_pair_overhead_pct": [round((r - 1) * 100, 2)
+                                      for r in ratios],
+            "best_vs_best_overhead_pct": round(
+                (off_max / on_max - 1.0) * 100.0, 2),
+            "updates_per_s_off": round(off_max, 1),
+            "updates_per_s_on": round(on_max, 1),
+            "freshness_status": fresh_snapshot,
+            "acceptance": "on/off regression must stay <= 5%",
+            "baseline": "the RTPU_FRESH=0 column of this same row",
+        },
+    }
+
+
 def bench_transfer_pipeline():
     """Serial vs pipelined transfer path — the tentpole's proof row.
 
@@ -2498,6 +2635,7 @@ CONFIGS = {
     "ldbc_traversal": bench_ldbc_traversal,
     "ingest": bench_ingest,
     "ingest_sustained": bench_ingest_sustained,
+    "ingest_obs_overhead": bench_ingest_obs_overhead,
     "scale_pagerank": bench_scale_pagerank,
     "scale_features": bench_scale_features,
 }

@@ -396,8 +396,21 @@ class DeviceSweep:
         f0 = _time.perf_counter()
         time = int(time)
         if self.t_now is not None and time < self.t_now:
-            raise ValueError(
-                f"DeviceSweep times must ascend (got {time} < {self.t_now})")
+            if not self._stale:
+                raise ValueError(
+                    f"DeviceSweep times must ascend "
+                    f"(got {time} < {self.t_now})")
+            # stale REWIND recovery: a mid-sweep failure can leave the
+            # lookahead fold (and t_now) PAST the hop a caller retries —
+            # how far depends on thread timing, so the ascending
+            # contract cannot be enforced against it. The fold only
+            # ascends, so rebuild the builder from the (pinned) log and
+            # refold to `time`; the stale path below restages the FULL
+            # state either way, and the device buffers were already
+            # behind the clock.
+            self.sw = SweepBuilder(self.sw.log, track_rows=False,
+                                   preseed_pairs=True)
+            self.t_now = None
         advanced = self.t_now is None or time > self.t_now
         if advanced:
             self.sw._advance(time)

@@ -17,6 +17,7 @@ import numpy as np
 
 from ..core import events as ev
 from ..core.events import EventLog
+from ..obs import freshness as _fresh
 from ..obs.metrics import METRICS
 from ..obs.trace import TRACER
 from .parser import IdentityParser, Parser
@@ -61,6 +62,10 @@ class IngestionPipeline:
         self._q_done = False
         self._writer: threading.Thread | None = None
         self._failed: set[str] = set()   # sources whose writer append died
+        # freshness plane (obs/freshness.py): weakly attached so /freshz
+        # and the /slz series ring can read this pipeline's staged
+        # backlog + queue bound without pinning it
+        _fresh.FRESH.attach_pipeline(self)
 
     @property
     def staged(self) -> bool:
@@ -79,6 +84,12 @@ class IngestionPipeline:
         parser = parser if parser is not None else IdentityParser()
         self._feeds.append((source, parser))
         self.watermarks.register(source.name)
+        # the declared disorder bound rides into the freshness plane so
+        # the out-of-order histogram can be judged against it (an
+        # observed distance PAST the bound is a watermark-promise risk
+        # the out-of-order-excess advisor rule alarms on)
+        _fresh.FRESH.register_source(source.name,
+                                     disorder=source.disorder)
         self.counts[source.name] = 0
 
     # ---- synchronous mode (tests, file replay, benchmarks) ----
@@ -182,6 +193,13 @@ class IngestionPipeline:
         """Deliver one parsed batch to the log: directly (default), or via
         the bounded queue (staged). The watermark advance rides WITH the
         batch so safe_time never overtakes events still in the queue."""
+        # freshness stamp at ARRIVAL, before any queueing: op mix,
+        # out-of-orderness vs the source high water, and the pending
+        # queryable record — staged-queue wait is part of
+        # ingest-to-queryable by design (obs/freshness.py; never raises)
+        if len(t):
+            _fresh.FRESH.note_batch(
+                name, t, k, stage="staged" if self.staged else "direct")
         if not self.staged:
             if len(t):
                 with TRACER.span("ingest.append", source=name,

@@ -26,6 +26,7 @@ import threading
 import numpy as np
 
 from ..core.events import EventLog
+from ..obs import freshness as _fresh
 
 __all__ = ["Shard", "ShardDownError", "ShardRouter", "merge_logs"]
 
@@ -95,7 +96,8 @@ class ShardRouter:
             raise ValueError("need at least one shard")
         self.shards: list[Shard] = list(shards)
         self._pending: dict[int, list[tuple]] = {}  # shard id → queued slices
-        self._lock = threading.Lock()
+        self._pending_n = 0    # queued EVENT count, maintained with
+        self._lock = threading.Lock()   # _pending: the O(1) gauge read
 
     # ---- elasticity ----
 
@@ -138,8 +140,9 @@ class ShardRouter:
             targets = list(self.shards)   # modulus frozen per batch
         n = len(targets)
         owner = (s % n + n) % n            # ids can be negative (hashes)
+        uniq, cnt = np.unique(owner, return_counts=True)
         prop_by_off = dict(props) if props else {}
-        for sid in np.unique(owner):
+        for sid in uniq:
             m = owner == sid
             rows = np.flatnonzero(m)
             sl_props = None
@@ -149,6 +152,19 @@ class ShardRouter:
                             if off in remap] or None
             self._deliver(targets[int(sid)],
                           (t[m], k[m], s[m], d[m], sl_props))
+        # router-stage freshness telemetry AFTER delivery: per-shard
+        # routed events + the dead-letter depth this batch left behind
+        # (obs/freshness.py /freshz router table). Guarded HERE — the
+        # callee checks too, but Python evaluates arguments first and
+        # RTPU_FRESH=0 must silence the whole cost, not just the store
+        if _fresh.enabled():
+            # keyed by Shard.id (callers may construct arbitrary ids),
+            # matching the dead-letter table's keys — not by modulus
+            # position
+            _fresh.FRESH.note_route(
+                {int(targets[int(a)].id): int(b)
+                 for a, b in zip(uniq, cnt)},
+                pending_events=self.pending_events())
 
     def _deliver(self, shard: Shard, sl: tuple) -> None:
         try:
@@ -157,10 +173,15 @@ class ShardRouter:
         except ShardDownError:
             with self._lock:
                 self._pending.setdefault(shard.id, []).append(sl)
+                self._pending_n += len(sl[0])
 
     def _drain(self, shard: Shard) -> None:
         with self._lock:
             queued = self._pending.pop(shard.id, [])
+        if not queued:
+            return
+        popped = sum(len(sl[0]) for sl in queued)
+        requeued = 0
         try:
             for i, sl in enumerate(queued):
                 shard.append_batch(*sl)
@@ -168,7 +189,15 @@ class ShardRouter:
             with self._lock:   # died again mid-drain: requeue the tail
                 self._pending[shard.id] = (queued[i:]
                                            + self._pending.get(shard.id, []))
+            requeued = sum(len(sl[0]) for sl in queued[i:])
             raise
+        finally:
+            # the counter mirrors the QUEUE exactly: everything popped
+            # minus what the down-shard path put back — a finally, so a
+            # non-ShardDownError failure (slices popped AND lost) can't
+            # leave the gauge inflated forever
+            with self._lock:
+                self._pending_n -= popped - requeued
 
     def revive(self, shard: Shard) -> None:
         """Deliver everything queued while the shard was down (call after
@@ -176,11 +205,15 @@ class ShardRouter:
         self._drain(shard)
 
     def pending_events(self, shard_id: int | None = None) -> int:
-        """Queued (undelivered) event count — the dead-letter gauge."""
+        """Queued (undelivered) event count — the dead-letter gauge.
+        The all-shards read is O(1) (a maintained counter: it is read
+        per routed batch during an outage, and summing the whole queue
+        each time would go quadratic over a long one)."""
         with self._lock:
-            items = (self._pending.get(shard_id, []) if shard_id is not None
-                     else [sl for q in self._pending.values() for sl in q])
-            return sum(len(sl[0]) for sl in items)
+            if shard_id is None:
+                return self._pending_n
+            return sum(len(sl[0])
+                       for sl in self._pending.get(shard_id, []))
 
 
 def merge_logs(logs: list[EventLog]) -> EventLog:

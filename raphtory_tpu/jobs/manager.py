@@ -24,6 +24,7 @@ from ..core.service import TemporalGraph
 from ..engine import bsp
 from ..engine.program import VertexProgram
 from ..obs import advisor as _advisor
+from ..obs import freshness as _fresh
 from ..obs import ledger as _ledger
 from ..obs import slo as _slo
 from ..obs import workload as _workload
@@ -358,6 +359,17 @@ class Job:
             else:
                 t = min(self.graph.safe_time(), self.graph.latest_time)
             self._run_at(t, q, exact=False)
+            # freshness plane (obs/freshness.py): this run's result
+            # reflects the graph at t — record its staleness against
+            # the ingest head, keyed by this job's trace id so a
+            # /freshz staleness exemplar resolves at /tracez
+            try:
+                head = int(self.graph.latest_time)
+            except Exception:   # empty log has no latest time
+                head = None
+            _fresh.note_live_result(
+                self.ledger.algorithm or type(self.program).__name__,
+                int(t), head_time=head, trace_id=self.trace_id)
             runs += 1
             if q.max_runs is not None and runs >= q.max_runs:
                 break

@@ -30,9 +30,13 @@ carry an ``X-RTPU-Tenant`` header or ``tenant`` body field), and
 estimates, measured-vs-estimated divergence and ``bound_measured``,
 device-memory snapshot or its honest degrade, the resident-buffer
 registry, and recent XLA compile events with the compile-storm signal —
-obs/device.py). ``/healthz`` is
-graded ok|degraded|burning from the ``RTPU_SLO_TARGET`` error budgets
-(obs/budget.py). POST bodies additionally accept ``explain`` (truthy):
+obs/device.py), and ``/freshz`` (the freshness plane: per-source ingest
+telemetry with out-of-orderness histograms, ingest-to-queryable latency
+with trace exemplars, live-result staleness quantiles and the
+``RTPU_FRESH_TARGET`` staleness-budget judgment — obs/freshness.py).
+``/healthz`` is graded ok|degraded|burning from the ``RTPU_SLO_TARGET``
+latency budgets joined with the ``RTPU_FRESH_TARGET`` staleness budgets
+(obs/budget.py, obs/freshness.py). POST bodies additionally accept ``explain`` (truthy):
 the job's resource ledger rides back with ``/AnalysisResults``.
 
 Serving-scheduler fields (jobs/scheduler.py, docs/SERVING.md): POST
@@ -59,6 +63,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..obs import budget as _budget
 from ..obs import device as _device
+from ..obs import freshness as _freshness
 from ..obs import ledger as _ledger
 from ..obs import slo as _slo
 from ..obs import workload as _workload
@@ -216,6 +221,11 @@ def _statusz(manager: AnalysisManager,
         "workload": _workload.WORKLOAD.status_block(),
         "budget": _budget.BUDGET.status_block(),
         "advisor": ADVISOR.status_block(),
+        # the freshness plane (obs/freshness.py): per-source updates/s
+        # total, staged backlog, queryable lag, staleness p99s and the
+        # RTPU_FRESH_TARGET grade — what /clusterz federates into the
+        # merged min-watermark / watermark-spread view
+        "freshness": _freshness.FRESH.status_block(),
         # the measured device plane (PR 12): sampled kernel-timing
         # totals, the memory snapshot (or its honest degrade), resident
         # bytes, and the compile-storm signal — what /clusterz federates
@@ -536,6 +546,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # bound_measured), device memory (or its degrade),
                 # resident buffers, recent compile events + storm
                 return self._json(200, _device.devicez())
+            if path == "/freshz":
+                # the freshness plane (obs/freshness.py): per-source
+                # ingest telemetry (op mix, out-of-orderness),
+                # ingest-to-queryable histograms with trace exemplars,
+                # live-result staleness quantiles, the staleness-budget
+                # judgment (RTPU_FRESH_TARGET)
+                return self._json(200, _freshness.freshz())
             if path == "/slz":
                 # SLO histograms + trace exemplars + the series ring
                 return self._json(

@@ -15,6 +15,7 @@ from .sampler import SAMPLER                       # stdlib-only
 from .workload import WORKLOAD                     # stdlib-only
 from .budget import BUDGET                         # stdlib-only
 from .advisor import ADVISOR                       # stdlib-only
+from .freshness import FRESH                       # stdlib-only (numpy lazy)
 
 try:
     # metrics + device profiling need prometheus_client / jax, which
@@ -30,4 +31,4 @@ __all__ = ["METRICS", "Metrics", "MetricsServer", "device_trace",
            "annotate", "TRACER", "TraceContext", "Tracer", "span",
            "Ledger", "REGISTRY", "instrument", "SLO", "SERIES",
            "SAMPLER", "WORKLOAD", "BUDGET", "ADVISOR", "RESIDENT",
-           "TIMING"]
+           "TIMING", "FRESH"]

@@ -51,6 +51,7 @@ from ..analysis.sanitizer import (note_shared as _san_note,
                                   track_shared as _san_track)
 from . import budget as _budget
 from . import device as _device
+from . import freshness as _freshness
 from . import ledger as _ledger
 from . import workload as _workload
 from .slo import _metrics
@@ -349,6 +350,122 @@ def rule_watermark_stale(sig: dict) -> dict | None:
         severity="warning")
 
 
+# ---- freshness rules: evaluate over the obs/freshness plane ----
+
+#: staged-backlog fraction of the queue bound past which the pipeline
+#: writer has lost the race (the saturation oracle the ingest bench
+#: reads the same way)
+INGEST_BACKLOG_FRAC = 0.8
+#: evidence floor before the out-of-order rule may speak
+OOO_MIN_EVENTS = 256
+
+
+def rule_ingest_backlog(sig: dict) -> dict | None:
+    """Some staged parse→append queue is pinned near ITS bound — the
+    writer has lost the race with the sources and backpressure is
+    throttling ingest (the paper's §6.1 saturation oracle, now judged
+    continuously instead of only in the bench). Saturation is judged
+    per queue: summing backlogs against the max bound would both
+    false-fire (two half-full queues) and mask (a small queue behind a
+    big bound)."""
+    fr = sig.get("freshness") or {}
+    queues = fr.get("staged_queues")
+    if queues is None:
+        # older/synthetic signal shape: fall back to the totals (both
+        # keys guarded — the per-queue loop below skips None backlogs)
+        queues = ([{"backlog_events": fr.get("backlog_events"),
+                    "queue_max_events": fr.get("queue_max_events")}]
+                  if fr.get("queue_max_events") else [])
+    worst = None
+    for q in queues:
+        b, qmax = q.get("backlog_events"), q.get("queue_max_events")
+        if not qmax or b is None:
+            continue
+        if worst is None or b / qmax > worst[0] / worst[1]:
+            worst = (b, qmax)
+    if worst is None or worst[0] < INGEST_BACKLOG_FRAC * worst[1]:
+        return None
+    backlog, qmax = worst
+    srcs = fr.get("sources") or {}
+    return _finding(
+        "ingest-backlog",
+        f"staged ingest backlog at {backlog}/{qmax} events "
+        f"({backlog / qmax:.0%} of the queue bound) — the append writer "
+        "is saturated and backpressure is throttling every source",
+        "RAPHTORY_TPU_INGEST_QUEUE_EVENTS",
+        "the writer, not the queue, is the bottleneck: shed or slow "
+        "sources, or shard ingest (ingestion/router.py); raising the "
+        "queue bound only buys latency, not throughput",
+        {"backlog_events": backlog, "queue_max_events": qmax,
+         "updates_per_s_by_source": {n: s.get("updates_per_s")
+                                     for n, s in srcs.items()},
+         "queryable_lag_seconds": fr.get("queryable_lag_seconds")},
+        severity="warning")
+
+
+def rule_ooo_excess(sig: dict) -> dict | None:
+    """A source's observed out-of-orderness EXCEEDS its declared
+    ``disorder`` bound — the watermark promise ("no event <= w will ever
+    be appended") is at risk: an exact view served at the fence may have
+    missed late events. The commutative store applies them correctly
+    once they land, but 'exact' answers served in between were not."""
+    srcs = (sig.get("freshness") or {}).get("sources") or {}
+    worst = None
+    for name, s in srcs.items():
+        if s.get("events", 0) < OOO_MIN_EVENTS:
+            continue
+        excess = s.get("ooo_max", 0) - max(0, s.get("disorder_bound", 0))
+        if excess > 0 and (worst is None or excess > worst[1]):
+            worst = (name, excess, s)
+    if worst is None:
+        return None
+    name, excess, s = worst
+    return _finding(
+        "out-of-order-excess",
+        f"source {name!r} emitted events up to {s['ooo_max']} event-time "
+        f"units behind its high water, {excess} past its declared "
+        f"disorder bound of {s['disorder_bound']} — watermarks promised "
+        "completeness they did not have",
+        "source.disorder",
+        f"raise {name!r}'s declared disorder bound to at least "
+        f"{s['ooo_max']} (the watermark then holds back far enough), or "
+        "fix the upstream ordering; /freshz carries the full "
+        "out-of-order distance histogram",
+        {"source": name, "ooo_max": s.get("ooo_max"),
+         "declared_disorder": s.get("disorder_bound"),
+         "ooo_events": s.get("ooo_events"), "events": s.get("events")},
+        severity="warning")
+
+
+def rule_freshness_burn(sig: dict) -> dict | None:
+    """Some RTPU_FRESH_TARGET staleness budget is burning — live
+    results are sustainably older than the operator promised. The
+    evidence names the stalled ingredient: backlog, queryable lag, or a
+    stalled watermark."""
+    fr = sig.get("freshness") or {}
+    bud = fr.get("budget") or {}
+    if bud.get("grade") != "burning":
+        return None
+    burning = [t for t in bud.get("targets", [])
+               if t.get("grade") == "burning"]
+    return _finding(
+        "freshness-burn",
+        f"staleness budgets burning for "
+        f"{[t['algorithm'] for t in burning]}: live results are "
+        "sustainably staler than RTPU_FRESH_TARGET promises",
+        "RTPU_FRESH_TARGET",
+        "find the slow ingredient: a stalled source (watermark "
+        "snapshot), a saturated staged queue (backlog), or analytics "
+        "that can't keep up with ingest (ROADMAP item 3's incremental "
+        "live algorithms are the structural fix); or relax the target",
+        {"burning_targets": burning,
+         "staleness_p99_seconds": fr.get("staleness_p99_seconds"),
+         "backlog_events": fr.get("backlog_events"),
+         "queryable_lag_seconds": fr.get("queryable_lag_seconds"),
+         "watermark_lag_seconds": sig.get("watermark_lag_seconds")},
+        severity="warning")
+
+
 # ---- device rules: evaluate over the obs/device measured plane ----
 
 #: mutual-divergence band for the model-divergence rule: per-kernel
@@ -552,6 +669,16 @@ RULES = (
     ("watermark-stale", rule_watermark_stale,
      "watermark lag + source snapshot",
      "the safe-time fence stopped advancing past the staleness bar"),
+    ("ingest-backlog", rule_ingest_backlog,
+     "/freshz staged backlog vs the queue bound",
+     "the parse→append queue is pinned: the writer lost the race"),
+    ("out-of-order-excess", rule_ooo_excess,
+     "/freshz per-source out-of-orderness vs the declared disorder",
+     "observed disorder exceeds the bound the watermark promise rests "
+     "on"),
+    ("freshness-burn", rule_freshness_burn,
+     "RTPU_FRESH_TARGET staleness budgets + /freshz evidence",
+     "live results sustainably staler than the operator promised"),
     ("device-model-divergence", rule_model_divergence,
      "/devicez measured kernel table (sampled timings vs model)",
      "measured/predicted ratios mutually inconsistent past the band — "
@@ -622,10 +749,22 @@ def gather_signals(manager=None, cluster: dict | None = None) -> dict:
         sig["fold_cache"] = cache.stats() if cache is not None else {}
     except Exception:
         sig["fold_cache"] = {}
+    try:
+        # the freshness plane (obs/freshness.py): per-source stream
+        # telemetry, staged backlog, staleness budget — what the
+        # ingest-backlog / out-of-order-excess / freshness-burn rules
+        # read
+        sig["freshness"] = _freshness.FRESH.advisor_signals()
+    except Exception:
+        sig["freshness"] = {}
     graph = getattr(manager, "graph", None) if manager is not None else None
     if graph is not None:
         try:
-            sig["watermark_lag_seconds"] = graph.watermarks.lag_seconds()
+            # lag_state separates idle (registered, no traffic — 0.0,
+            # never an alarm) from a genuinely stalled active fence
+            state, lag = graph.watermarks.lag_state()
+            sig["watermark_lag_seconds"] = lag
+            sig["watermark_lag_state"] = state
             sig["watermark_sources"] = {
                 k: int(v) for k, v in graph.watermarks.snapshot().items()}
         except Exception:

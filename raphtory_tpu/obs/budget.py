@@ -161,15 +161,17 @@ def parse_targets(raw: str | None = None) -> tuple[list, list]:
 
 
 def window_burn(rows: list, algorithm: str, now: float, window_s: float,
-                allowed: float) -> float | None:
+                allowed: float, prefix: str = "slo") -> float | None:
     """Burn rate over series-ring ``rows`` inside ``[now - window_s,
     now]``: (breaches / observations in the window) / allowed. ``None``
     when the window holds fewer than two usable samples (nothing to
     difference — the ring may be off or younger than the window);
     ``0.0`` when the window saw no traffic (no requests burn nothing).
-    Pure over its inputs so the burn math tests under injected clocks."""
-    obs_name = f"slo_obs_{algorithm}_total"
-    bad_name = f"slo_bad_{algorithm}_total"
+    Pure over its inputs so the burn math tests under injected clocks.
+    ``prefix`` selects the collector family: ``slo`` (latency budgets,
+    this module) or ``fresh`` (staleness budgets, obs/freshness.py)."""
+    obs_name = f"{prefix}_obs_{algorithm}_total"
+    bad_name = f"{prefix}_bad_{algorithm}_total"
     inside = [r for r in rows
               if r.get("unix", 0.0) >= now - window_s
               and r.get(obs_name) is not None
@@ -181,6 +183,45 @@ def window_burn(rows: list, algorithm: str, now: float, window_s: float,
     if d_obs <= 0:
         return 0.0
     return max(0.0, d_bad / d_obs) / allowed
+
+
+def judge_target(t: Target, rows: list, now: float, fast_s: float,
+                 slow_s: float, totals_below, prefix: str = "slo"
+                 ) -> tuple[dict, str, float, float]:
+    """One target's full burn judgment — the grading core BOTH budget
+    planes share (latency here, staleness in obs/freshness.py), so the
+    burn math and the 2-of-2 grade ladder can never diverge between
+    them. ``totals_below(algorithm, threshold_s) -> (total, good)`` is
+    the plane's histogram walk; returns ``(row, grade, eff_fast,
+    eff_slow)`` where the eff burns fall back to the cumulative burn
+    when a window has no usable ring samples (dead/young ring — the
+    honest reading of "all the evidence we have")."""
+    total, good = totals_below(t.algorithm, t.threshold_s)
+    bad = total - good
+    cum = ((bad / total) / t.allowed) if total else 0.0
+    fast = window_burn(rows, t.algorithm, now, fast_s, t.allowed,
+                       prefix=prefix)
+    slow = window_burn(rows, t.algorithm, now, slow_s, t.allowed,
+                       prefix=prefix)
+    eff_fast = cum if fast is None else fast
+    eff_slow = cum if slow is None else slow
+    if eff_fast >= 1.0 and eff_slow >= 1.0:
+        grade = "burning"
+    elif eff_fast >= 1.0 or eff_slow >= 1.0:
+        grade = "degraded"
+    else:
+        grade = "ok"
+    row = dict(t.as_dict())
+    row.update({
+        "observations": total, "breaches": bad,
+        "cumulative_burn": round(cum, 4),
+        "budget_remaining": round(1.0 - cum, 4),
+        "fast_burn": None if fast is None else round(fast, 4),
+        "slow_burn": None if slow is None else round(slow, 4),
+        "windows_seconds": [fast_s, slow_s],
+        "grade": grade,
+    })
+    return row, grade, eff_fast, eff_slow
 
 
 def _retire(alg: str) -> None:
@@ -303,39 +344,17 @@ class BudgetRegistry:
         grade = "ok"
         m = _metrics()
         for t in targets:
-            total, good = SLO.totals_below(t.algorithm, "e2e",
-                                           t.threshold_s)
-            bad = total - good
-            cum_burn = ((bad / total) / t.allowed) if total else 0.0
-            fast = window_burn(rows, t.algorithm, now, fast_s, t.allowed)
-            slow = window_burn(rows, t.algorithm, now, slow_s, t.allowed)
-            # dead/young ring: the cumulative burn is all the evidence
-            eff_fast = cum_burn if fast is None else fast
-            eff_slow = cum_burn if slow is None else slow
-            if eff_fast >= 1.0 and eff_slow >= 1.0:
-                t_grade = "burning"
-            elif eff_fast >= 1.0 or eff_slow >= 1.0:
-                t_grade = "degraded"
-            else:
-                t_grade = "ok"
+            row, t_grade, eff_fast, eff_slow = judge_target(
+                t, rows, now, fast_s, slow_s,
+                lambda alg, thr: SLO.totals_below(alg, "e2e", thr))
             if _GRADE_ORDER[t_grade] > _GRADE_ORDER[grade]:
                 grade = t_grade
-            row = dict(t.as_dict())
-            row.update({
-                "observations": total, "breaches": bad,
-                "cumulative_burn": round(cum_burn, 4),
-                "budget_remaining": round(1.0 - cum_burn, 4),
-                "fast_burn": None if fast is None else round(fast, 4),
-                "slow_burn": None if slow is None else round(slow, 4),
-                "windows_seconds": [fast_s, slow_s],
-                "grade": t_grade,
-            })
             out_targets.append(row)
             if m is not None:
                 m.slo_burn_rate.labels(t.algorithm, "fast").set(eff_fast)
                 m.slo_burn_rate.labels(t.algorithm, "slow").set(eff_slow)
                 m.slo_budget_remaining.labels(t.algorithm).set(
-                    1.0 - cum_burn)
+                    row["budget_remaining"])
             with self._lock:
                 prev = self._last_grades.get(t.algorithm, "ok")
                 self._last_grades[t.algorithm] = t_grade
@@ -389,14 +408,30 @@ BUDGET = BudgetRegistry()
 
 def healthz() -> tuple[int, dict]:
     """``(http_status, payload)`` for ``GET /healthz``: the liveness
-    answer graded from the error-budget state. 503 ONLY when some budget
-    is burning AND ``RTPU_HEALTH_STRICT=1`` — the default keeps the
-    pre-budget contract (always 200, grade in the body) so existing
-    probes never flap on an operator's first target."""
+    answer graded from the error-budget state — latency budgets (this
+    module) joined with the staleness budgets (obs/freshness.py,
+    ``RTPU_FRESH_TARGET``); the worse grade wins. 503 ONLY when the
+    joined grade is burning AND ``RTPU_HEALTH_STRICT=1`` — the default
+    keeps the pre-budget contract (always 200, grade in the body) so
+    existing probes never flap on an operator's first target."""
     ev = BUDGET.evaluate()
-    code = 503 if ev["grade"] == "burning" and ev["strict"] else 200
-    payload = {"status": ev["grade"], "strict": ev["strict"],
+    grade = ev["grade"]
+    payload = {"status": grade, "strict": ev["strict"],
                "targets": ev["targets"]}
     if ev["errors"]:
         payload["target_errors"] = ev["errors"]
+    try:   # lazy + tolerant: a freshness-plane bug must not take the
+        from .freshness import FRESH   # liveness probe down
+
+        fr = FRESH.budget_evaluate()
+    except Exception:
+        fr = None
+    if fr is not None and (fr["targets"] or fr["errors"]):
+        payload["freshness"] = fr["targets"]
+        if fr["errors"]:
+            payload["freshness_target_errors"] = fr["errors"]
+        if _GRADE_ORDER[fr["grade"]] > _GRADE_ORDER[grade]:
+            grade = fr["grade"]
+            payload["status"] = grade
+    code = 503 if grade == "burning" and ev["strict"] else 200
     return code, payload

@@ -9,7 +9,9 @@ merged cluster view — membership, per-process queue depth and watermark
 lag, per-route collective seconds/bytes/rows, per-shard halo/degree skew,
 per-process barrier wait, cross-process traces reassembled by id, plus
 the judgment plane (PR 11): mesh-wide per-tenant workload totals and the
-union of firing advisor rules with per-process attribution.
+union of firing advisor rules with per-process attribution, and the
+freshness plane (ISSUE 15): a merged min-watermark + per-process
+watermark spread — the lagging-ingest-shard straggler signal.
 
 Design rules (the RT009/RT011 lint territory this module sits in):
 
@@ -264,6 +266,9 @@ def _peer_summary(status: dict) -> dict:
         # the measured device plane (PR 12): timing totals, memory
         # snapshot (or degrade), resident bytes, compile-storm signal
         "device": status.get("device"),
+        # the freshness plane (obs/freshness.py): updates/s, backlog,
+        # queryable lag, staleness grade — already compact at the source
+        "freshness": status.get("freshness"),
     }
 
 
@@ -333,6 +338,53 @@ def _merge_device(processes: dict) -> dict:
             "kernels_measured_total": measured,
             "memory_by_process": memory,
             "compile_storms": sorted(storms)}
+
+
+def _merge_freshness(processes: dict) -> dict:
+    """The mesh's freshness view: merged min-watermark (the fence the
+    CLUSTER can serve exactly at — one lagging ingest shard drags it),
+    per-process safe times and watermark lags, and the watermark SPREAD
+    (max lag − min lag): a lagging ingest shard is a straggler the
+    barrier-wait signals can't see, because it stalls the fence, not a
+    collective."""
+    safe: dict[str, int] = {}
+    lags: dict[str, float] = {}
+    ups = 0.0
+    backlog = 0
+    grades: dict[str, str] = {}
+    for name, p in processes.items():
+        if not p.get("reachable"):
+            continue
+        # the ±2^62 fence sentinels (all-done / idle-registered) are
+        # not times: a serving-only or replay-finished process must not
+        # put 4611686018427387904 into the merged min (the freshness
+        # plane nulls the same sentinels on /statusz)
+        if p.get("safe_time") is not None \
+                and abs(int(p["safe_time"])) < 2**62:
+            safe[name] = int(p["safe_time"])
+        if p.get("watermark_lag_seconds") is not None:
+            lags[name] = float(p["watermark_lag_seconds"])
+        fr = p.get("freshness") or {}
+        ups += float(fr.get("updates_per_s") or 0.0)
+        backlog += int(fr.get("backlog_events") or 0)
+        if fr.get("grade"):
+            grades[name] = fr["grade"]
+    out: dict = {
+        "min_safe_time": min(safe.values()) if safe else None,
+        "safe_time_by_process": safe,
+        "watermark_lag_by_process": {n: round(v, 3)
+                                     for n, v in lags.items()},
+        "watermark_spread_seconds": (round(max(lags.values())
+                                           - min(lags.values()), 3)
+                                     if len(lags) >= 2 else 0.0),
+        "updates_per_s_total": round(ups, 1),
+        "backlog_events_total": backlog,
+        "grade_by_process": grades,
+    }
+    if safe:
+        worst = min(safe, key=safe.get)
+        out["min_safe_process"] = worst
+    return out
 
 
 def _merge_advisor(processes: dict) -> dict:
@@ -412,6 +464,7 @@ def clusterz(manager=None, handler=None, trace_id: str | None = None,
         "workload": _merge_workload(processes),
         "advisor": _merge_advisor(processes),
         "device": _merge_device(processes),
+        "freshness": _merge_freshness(processes),
         "stragglers": {
             name: p["collectives"]["barrier_wait_seconds"]
             for name, p in processes.items()

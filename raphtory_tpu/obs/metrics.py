@@ -54,6 +54,55 @@ class Metrics:
             "Events parsed but not yet appended to the log (bounded-"
             "mailbox depth; the WriterLogger queue-size analogue)",
             registry=r)
+        # freshness plane (obs/freshness.py): per-source stream
+        # telemetry + ingest-to-queryable + live-result staleness.
+        # Source label cardinality is bounded by the deployment's source
+        # set (same contract as events_ingested); algorithm by the
+        # registry + the freshness MAX_ALGOS cap.
+        self.ingest_batches = Counter(
+            "raphtory_ingest_batches_total",
+            "Sink batches that arrived from a source", ["source"],
+            registry=r)
+        self.ingest_batch_events = Histogram(
+            "raphtory_ingest_batch_events",
+            "Events per sink batch (the vectorisation amortisation "
+            "factor of the ingest hot path)",
+            buckets=(1, 8, 64, 512, 4096, 32768, 262144, float("inf")),
+            registry=r)
+        self.ingest_ooo_events = Counter(
+            "raphtory_ingest_out_of_order_events_total",
+            "Events that arrived with event time behind their source's "
+            "high-water mark (safe under the commutative store; the "
+            "distance distribution lives on /freshz)", ["source"],
+            registry=r)
+        self.ingest_tombstones = Counter(
+            "raphtory_ingest_tombstone_events_total",
+            "Vertex/edge DELETE events ingested (the tombstone half of "
+            "the op-type mix)", ["source"], registry=r)
+        self.freshness_queryable = Histogram(
+            "raphtory_freshness_queryable_seconds",
+            "Ingest-to-queryable latency: sink-batch arrival until the "
+            "global safe time covered the batch's max event time "
+            "(trace-ID exemplars on /freshz)", ["source"],
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                     30.0, 60.0, 300.0, float("inf")), registry=r)
+        self.freshness_staleness = Histogram(
+            "raphtory_freshness_staleness_seconds",
+            "Live-query result staleness: wall seconds since the "
+            "result's watermark stopped being the ingest head",
+            ["algorithm"],
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                     30.0, 60.0, 300.0, float("inf")), registry=r)
+        self.freshness_burn_rate = Gauge(
+            "raphtory_freshness_burn_rate",
+            "Staleness error-budget burn rate per RTPU_FRESH_TARGET "
+            "and window (>1 in both windows = burning; grades "
+            "/healthz)", ["algorithm", "window"], registry=r)
+        self.freshness_pending = Gauge(
+            "raphtory_freshness_pending_batches",
+            "Sink batches appended but not yet covered by the global "
+            "safe time (the not-yet-queryable backlog)", registry=r)
+        self.freshness_pending.set_function(_freshness_pending)
         # storage (WriterLogger gauges)
         self.log_events = Gauge(
             "raphtory_log_events", "Rows in the event log", registry=r)
@@ -384,6 +433,17 @@ def _device_bytes_in_use() -> float:
         from .device import gauge_bytes_in_use
 
         return gauge_bytes_in_use()
+    except Exception:
+        return 0.0
+
+
+def _freshness_pending() -> float:
+    """Scrape-time not-yet-queryable batch count — never raises; lazy
+    import keeps metrics importable without the freshness plane."""
+    try:
+        from .freshness import FRESH
+
+        return float(FRESH.pending_batches())
     except Exception:
         return 0.0
 

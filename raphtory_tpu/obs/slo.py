@@ -258,6 +258,26 @@ def _device_resident_bytes() -> float:
     return float(RESIDENT.snapshot()["total_bytes"])
 
 
+def _ingest_events_total() -> float:
+    """Freshness-plane collector (obs/freshness.py): cumulative ingested
+    events — the ring's ``_total`` differencing renders updates/s."""
+    from .freshness import FRESH
+
+    return FRESH.total_events()
+
+
+def _ingest_backlog_events() -> float:
+    from .freshness import FRESH
+
+    return FRESH.backlog_events()
+
+
+def _queryable_lag_seconds() -> float:
+    from .freshness import FRESH
+
+    return FRESH.queryable_lag_seconds()
+
+
 def sparkline(values: list[float]) -> str:
     """Text sparkline over ``values`` (min..max scaled to 8 levels);
     constant series render flat-low."""
@@ -308,6 +328,13 @@ class SeriesRing:
         # the resident-buffer registry's total
         self.register("device_bytes_in_use", _device_bytes_in_use)
         self.register("device_resident_bytes", _device_resident_bytes)
+        # freshness plane (obs/freshness.py): ingested events (the
+        # ``_total`` differencing renders updates/s), the staged
+        # parse→append backlog, and the age of the oldest batch the
+        # safe-time fence has not yet covered
+        self.register("ingest_events_total", _ingest_events_total)
+        self.register("ingest_backlog_events", _ingest_backlog_events)
+        self.register("queryable_lag_seconds", _queryable_lag_seconds)
 
     # ---- collectors ----
 

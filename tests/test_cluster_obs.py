@@ -461,6 +461,8 @@ def test_post_adopts_wire_trace_context(rest_node, traced):
 
 
 def test_get_scrape_header_joins_trace(rest_node, traced):
+    import time as _t
+
     g, mgr, srv = rest_node
     ctx = TraceContext("scrape-trace-1", 3, origin=1)
     req = urllib.request.Request(
@@ -468,7 +470,16 @@ def test_get_scrape_header_joins_trace(rest_node, traced):
         headers={TraceContext.HEADER: ctx.to_wire()})
     with urllib.request.urlopen(req, timeout=10) as r:
         assert r.status == 200
-    spans = TRACER.for_trace("scrape-trace-1")
+        r.read()
+    # the client can return before the handler thread EXITS the span
+    # (urlopen needs only the buffered response; the span records at
+    # completion) — poll briefly instead of racing it
+    deadline = _t.monotonic() + 5.0
+    while _t.monotonic() < deadline:
+        spans = TRACER.for_trace("scrape-trace-1")
+        if any(s["name"] == "rest.serve_scrape" for s in spans):
+            break
+        _t.sleep(0.02)
     assert any(s["name"] == "rest.serve_scrape" for s in spans)
 
 

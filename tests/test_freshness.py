@@ -1,0 +1,836 @@
+"""Freshness plane: per-source ingest telemetry, ingest-to-queryable
+latency, live-result staleness SLOs, /freshz + /clusterz federation
+(ISSUE 15)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raphtory_tpu.core.service import TemporalGraph
+from raphtory_tpu.ingestion.pipeline import IngestionPipeline
+from raphtory_tpu.ingestion.source import IterableSource, Source
+from raphtory_tpu.ingestion.updates import EdgeAdd, EdgeDelete, VertexDelete
+from raphtory_tpu.ingestion.watermark import WatermarkRegistry
+from raphtory_tpu.obs import freshness as fr
+from raphtory_tpu.obs.freshness import FRESH, FreshnessRegistry
+from raphtory_tpu.obs.trace import TRACER
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    FRESH.clear()
+    yield
+    FRESH.clear()
+
+
+@pytest.fixture
+def traced():
+    was = TRACER.enabled
+    TRACER.enable()
+    TRACER.clear()
+    try:
+        yield TRACER
+    finally:
+        TRACER.enabled = was
+
+
+def _t(vals):
+    return np.asarray(vals, np.int64)
+
+
+def _k(vals):
+    return np.asarray(vals, np.uint8)
+
+
+# ---- per-source ingest telemetry ----
+
+def test_note_batch_counts_and_mix():
+    r = FreshnessRegistry()
+    r.register_source("s", disorder=3)
+    # kinds: 0=vadd 1=vdel 2=eadd 3=edel — row-path-sized batch counts
+    # the mix exactly
+    r.note_batch("s", _t([1, 2, 3, 4]), _k([2, 2, 3, 1]), now=100.0)
+    r.note_batch("s", _t([5, 6]), _k([2, 2]), now=100.5)
+    doc = r.freshz()
+    s = doc["sources"]["s"]
+    assert s["events"] == 6 and s["batches"] == 2
+    assert s["max_batch_events"] == 4
+    assert s["kinds"] == {"vertex_add": 0, "vertex_delete": 1,
+                          "edge_add": 4, "edge_delete": 1}
+    assert s["tombstone_fraction"] == pytest.approx(2 / 6, abs=1e-3)
+    assert s["disorder_bound"] == 3
+    assert s["high_water_time"] == 6
+
+
+def test_out_of_order_histogram_and_bounds():
+    r = FreshnessRegistry()
+    r.register_source("s", disorder=10)
+    # in-order batch: zero ooo
+    r.note_batch("s", _t([10, 20, 30]), now=1.0)
+    s = r.freshz()["sources"]["s"]
+    assert s["out_of_order"]["events"] == 0
+    # within-batch disorder (25 is 5 behind the running max 30) and
+    # behind-the-high-water arrival (2 is 28 behind)
+    r.note_batch("s", _t([25, 2, 40]), now=2.0)
+    s = r.freshz()["sources"]["s"]
+    ooo = s["out_of_order"]
+    assert ooo["events"] == 2
+    assert ooo["max_distance"] == 28
+    # distances 5 → bucket (1,10], 28 → bucket (10,100]
+    assert ooo["counts"][1] == 1 and ooo["counts"][2] == 1
+    assert ooo["past_disorder_bound"] is True   # 28 > declared 10
+
+
+def test_deep_pass_sampling_keeps_totals_exact():
+    """Big columnar batches pay the O(n) passes 1-in-DEEP_SAMPLE, but
+    event totals / batch sizes / high water stay exact on EVERY batch;
+    the mix coverage counter records what the sampled counts cover."""
+    r = FreshnessRegistry()
+    r.register_source("s")
+    n = fr.DEEP_EXACT_N
+    for i in range(8):
+        t = np.arange(i * n, (i + 1) * n, dtype=np.int64)
+        r.note_batch("s", t, np.full(n, 2, np.uint8), now=float(i))
+    s = r.freshz()["sources"]["s"]
+    assert s["events"] == 8 * n                      # exact
+    assert s["high_water_time"] == 8 * n - 1         # exact
+    assert s["batches"] == 8
+    # 1-in-4 deep batches covered the mix/ooo passes
+    assert s["mix_sampled_events"] == 2 * n
+    assert s["out_of_order"]["sampled_events"] == 2 * n
+    assert s["kinds"]["edge_add"] == 2 * n
+
+
+def test_pending_cap_bounds_memory(monkeypatch):
+    monkeypatch.setenv("RTPU_FRESH_PENDING", "16")
+    r = FreshnessRegistry()
+    r.register_source("s")
+    for i in range(40):
+        r.note_batch("s", _t([i]), now=float(i))
+    s = r.freshz()["sources"]["s"]
+    assert s["pending_batches"] == 16
+    assert s["pending_dropped"] == 24
+
+
+def test_source_cap_bounds_registry():
+    r = FreshnessRegistry()
+    for i in range(fr.MAX_SOURCES + 5):
+        r.register_source(f"s{i}")
+    assert r.dropped_sources == 5
+    assert len(r.freshz()["sources"]) == fr.MAX_SOURCES
+
+
+def test_rtpu_fresh_zero_silences_observation(monkeypatch):
+    monkeypatch.setenv("RTPU_FRESH", "0")
+    r = FreshnessRegistry()
+    r.note_batch("s", _t([1, 2, 3]))
+    r.note_live_result("PageRank", 1, head_time=3)
+    r.note_safe(10)
+    doc = r.freshz()
+    assert doc["enabled"] is False
+    assert doc["sources"] == {} and doc["staleness_seconds"] == {}
+
+
+# ---- ingest-to-queryable latency ----
+
+def test_queryable_drains_on_safe_advance():
+    r = FreshnessRegistry()
+    r.register_source("s")
+    r.note_batch("s", _t([1, 2, 3]), now=100.0)
+    r.note_batch("s", _t([4, 5, 6]), now=101.0)
+    assert r.pending_batches() == 2
+    # fence at 3: only the first batch (max_t 3) became queryable
+    r.note_safe(3, now=105.0)
+    s = r.freshz()["sources"]["s"]
+    assert s["pending_batches"] == 1
+    q = s["queryable_seconds"]
+    assert q["count"] == 1
+    # latency 5.0s → the 5.0 bucket
+    assert q["p99"] == pytest.approx(5.0)
+    # fence past everything drains the rest
+    r.note_safe(2**62, now=106.0)
+    assert r.pending_batches() == 0
+    assert r.freshz()["sources"]["s"]["queryable_seconds"]["count"] == 2
+
+
+def test_late_batch_drains_at_its_own_fence_bar():
+    """A late (out-of-order) batch becomes queryable when the fence
+    covers ITS events — not the source's high water at arrival (which
+    would overstate ingest-to-queryable by up to the disorder bound),
+    and not behind an earlier higher-max batch in the deque."""
+    r = FreshnessRegistry()
+    r.register_source("s", disorder=100)
+    r.note_batch("s", _t([100]), now=1.0)      # high water → 100
+    r.note_batch("s", _t([40, 50]), now=2.0)   # late batch, own max 50
+    r.note_safe(60, now=5.0)                   # covers only the late one
+    s = r.freshz()["sources"]["s"]
+    assert s["queryable_seconds"]["count"] == 1   # drained at ITS bar
+    assert s["pending_batches"] == 1              # the max-100 batch waits
+    r.note_safe(100, now=6.0)
+    assert r.pending_batches() == 0
+
+
+def test_queryable_exemplar_carries_trace_id():
+    r = FreshnessRegistry()
+    r.register_source("s")
+    r.note_batch("s", _t([1]), trace_id="tr-queryable", now=10.0)
+    r.note_safe(1, now=10.5)
+    q = r.freshz()["sources"]["s"]["queryable_seconds"]
+    ex = q["p99_exemplar"]
+    assert ex and ex["trace_id"] == "tr-queryable"
+
+
+def test_queryable_lag_is_oldest_pending_age():
+    r = FreshnessRegistry()
+    r.register_source("s")
+    assert r.queryable_lag_seconds(now=50.0) == 0.0
+    r.note_batch("s", _t([1]), now=10.0)
+    r.note_batch("s", _t([2]), now=40.0)
+    assert r.queryable_lag_seconds(now=50.0) == pytest.approx(40.0)
+    r.note_safe(1, now=50.0)
+    assert r.queryable_lag_seconds(now=50.0) == pytest.approx(10.0)
+
+
+def test_note_safe_finished_sentinel_never_freezes_draining():
+    """The all-sources-finished fence (2^62) drains everything but is
+    never stored as a time: a NEW live source registering later moves
+    the fence back down, and its batches must still drain (storing the
+    sentinel would make the monotone guard ignore every later real
+    advance forever) — and last_safe_time must render null, not
+    4611686018427387904."""
+    r = FreshnessRegistry()
+    r.register_source("a")
+    r.note_batch("a", _t([5]), now=1.0)
+    r.note_safe(2**62, now=2.0)                  # all done: drain all
+    assert r.pending_batches() == 0
+    assert r.freshz()["last_safe_time"] is None  # sentinel is not a time
+    # a late-joining source streams: the fence is finite again
+    r.register_source("b")
+    r.note_batch("b", _t([10]), now=3.0)
+    r.note_safe(10, now=4.0)                     # must NOT be ignored
+    assert r.pending_batches() == 0
+    assert r.freshz()["sources"]["b"]["queryable_seconds"]["count"] == 1
+    assert r.freshz()["last_safe_time"] == 10
+
+
+def test_deep_sampling_unbiased_on_mixed_batch_sizes():
+    """The 1-in-DEEP_SAMPLE decision keys on the LARGE-batch counter:
+    a stream alternating small and large batches must still deep-sample
+    exactly 1 in 4 of its large batches (keying on the global batch
+    counter would let the small batches alias the phase and skip the
+    large half entirely)."""
+    r = FreshnessRegistry()
+    r.register_source("s")
+    n = fr.DEEP_EXACT_N
+    for i in range(8):
+        # small batch (always deep/exact) then large batch
+        base = i * (n + 1)
+        r.note_batch("s", _t([base]), _k([2]), now=float(i))
+        t = np.arange(base + 1, base + 1 + n, dtype=np.int64)
+        r.note_batch("s", t, np.full(n, 2, np.uint8), now=float(i) + 0.5)
+    s = r.freshz()["sources"]["s"]
+    # 8 small (exact) + 2 of 8 large batches deep-sampled
+    assert s["mix_sampled_events"] == 8 + 2 * n
+    assert s["out_of_order"]["sampled_events"] == 8 + 2 * n
+
+
+# ---- live-result staleness ----
+
+def test_staleness_fresh_result_is_zero():
+    r = FreshnessRegistry()
+    r.note_batch("s", _t([100]), now=10.0)
+    r.note_live_result("PageRank", 100, now=20.0)
+    h = r.freshz()["staleness_seconds"]["PageRank"]
+    assert h["count"] == 1
+    assert h["counts"][0] == 1   # 0.0s → the first bucket
+
+
+def test_staleness_dated_by_head_clock():
+    r = FreshnessRegistry()
+    r.note_batch("s", _t([100]), now=10.0)
+    r.note_batch("s", _t([200]), now=12.0)
+    r.note_batch("s", _t([300]), now=14.0)
+    # result at 150: the head passed it at wall 12.0 (the 200 batch) —
+    # staleness = 20 - 12 = 8s → the 10.0 bucket
+    r.note_live_result("PageRank", 150, trace_id="tr-stale", now=20.0)
+    h = r.freshz()["staleness_seconds"]["PageRank"]
+    assert h["count"] == 1
+    assert h["p99"] == pytest.approx(10.0)
+    assert h["p99_exemplar"]["trace_id"] == "tr-stale"
+
+
+def test_staleness_undated_is_counted_not_guessed():
+    r = FreshnessRegistry()
+    # no head clock, no head_time: nothing to date against
+    r.note_live_result("PageRank", 5, now=1.0)
+    assert r.undated_results == 1
+    # head_time backstop: result at the head is fresh
+    r.note_live_result("PageRank", 5, head_time=5, now=2.0)
+    doc = r.freshz()
+    assert doc["staleness_seconds"]["PageRank"]["count"] == 1
+    # behind a head the clock never recorded: undated again
+    r.note_live_result("PageRank", 3, head_time=9, now=3.0)
+    assert r.undated_results == 2
+
+
+# ---- the RTPU_FRESH_TARGET staleness budget ----
+
+def _feed_staleness(r, alg, values):
+    r.note_batch("s", _t([1000]), now=0.0)
+    for v in values:
+        # result_time 500 went stale at wall 0.0; observing at now=v
+        # lands a staleness of exactly v seconds
+        r.note_live_result(alg, 500, now=v)
+
+
+def test_fresh_budget_grades_cumulative(monkeypatch):
+    monkeypatch.setenv("RTPU_FRESH_TARGET", "pagerank=p50:1s")
+    r = FreshnessRegistry()
+    _feed_staleness(r, "PageRank", [0.1, 0.2, 0.3, 0.4])
+    ev = r.budget_evaluate(now=10.0, rows=[])
+    assert ev["grade"] == "ok"
+    assert ev["targets"][0]["observations"] == 4
+    # now breach: > half past 1s → cumulative burn > 1 in both windows
+    # (dead ring falls back to cumulative) → burning
+    _feed_staleness(r, "PageRank", [5.0] * 8)
+    ev = r.budget_evaluate(now=20.0, rows=[])
+    assert ev["targets"][0]["breaches"] == 8
+    assert ev["grade"] == "burning"
+
+
+def test_fresh_budget_windowed_burn(monkeypatch):
+    monkeypatch.setenv("RTPU_FRESH_TARGET", "pagerank=p90:1s")
+    r = FreshnessRegistry()
+    # injected ring rows: the fresh_* collectors' differenced series
+    rows = [
+        {"unix": 100.0, "fresh_obs_pagerank_total": 0.0,
+         "fresh_bad_pagerank_total": 0.0},
+        {"unix": 130.0, "fresh_obs_pagerank_total": 100.0,
+         "fresh_bad_pagerank_total": 50.0},
+    ]
+    ev = r.budget_evaluate(now=130.0, rows=rows)
+    t = ev["targets"][0]
+    # 50% bad / 10% allowed = 5x burn in the fast window; slow window
+    # has the same two samples
+    assert t["fast_burn"] == pytest.approx(5.0)
+    assert ev["grade"] == "burning"
+
+
+def test_fresh_budget_malformed_target_is_data(monkeypatch):
+    monkeypatch.setenv("RTPU_FRESH_TARGET", "pagerank=banana")
+    r = FreshnessRegistry()
+    ev = r.budget_evaluate(now=1.0, rows=[])
+    assert ev["errors"] and ev["grade"] == "ok"
+
+
+def test_healthz_merges_freshness_grade(monkeypatch):
+    from raphtory_tpu.obs.budget import healthz
+
+    monkeypatch.setenv("RTPU_FRESH_TARGET", "pagerank=p50:1s")
+    monkeypatch.delenv("RTPU_SLO_TARGET", raising=False)
+    _feed_staleness(FRESH, "PageRank", [5.0] * 8)
+    code, payload = healthz()
+    assert code == 200                     # strict off: grade in body
+    assert payload["status"] == "burning"
+    assert payload["freshness"][0]["algorithm"] == "pagerank"
+    monkeypatch.setenv("RTPU_HEALTH_STRICT", "1")
+    code, _ = healthz()
+    assert code == 503
+
+
+def test_fresh_collectors_register_and_retire(monkeypatch):
+    from raphtory_tpu.obs.slo import SERIES
+
+    monkeypatch.setenv("RTPU_FRESH_TARGET", "pagerank=p99:1s")
+    FRESH.budget_evaluate(now=1.0, rows=[])
+    assert "fresh_obs_pagerank_total" in SERIES._collectors
+    monkeypatch.setenv("RTPU_FRESH_TARGET", "")
+    FRESH.budget_evaluate(now=2.0, rows=[])
+    assert "fresh_obs_pagerank_total" not in SERIES._collectors
+
+
+def test_non_singleton_registry_never_touches_the_global_ring(monkeypatch):
+    """A throwaway FreshnessRegistry (tests, tooling) must not register
+    self-capturing collectors into the process-global series ring — it
+    would be pinned alive and clobber the singleton's collectors; it
+    keeps the cumulative-burn fallback instead."""
+    from raphtory_tpu.obs.slo import SERIES
+
+    monkeypatch.setenv("RTPU_FRESH_TARGET", "pagerank=p99:1s")
+    r = FreshnessRegistry()
+    _feed_staleness(r, "PageRank", [5.0] * 8)
+    ev = r.budget_evaluate(now=10.0, rows=[])
+    assert "fresh_obs_pagerank_total" not in SERIES._collectors
+    # windowed burns fall back to the cumulative burn (dead-ring rule)
+    assert ev["grade"] == "burning"
+
+
+# ---- watermark idle/active state (satellite 1) ----
+
+def test_lag_state_idle_vs_active_vs_done():
+    wm = WatermarkRegistry()
+    assert wm.lag_state() == ("done", 0.0)          # nothing registered
+    wm.register("s")
+    # registered but NEVER advanced: idle — no traffic is not a stall
+    state, lag = wm.lag_state()
+    assert state == "idle" and lag == 0.0
+    assert wm.lag_seconds() == 0.0
+    assert wm.source_states() == {"s": "idle"}
+    wm.advance("s", 100)
+    state, lag = wm.lag_state()
+    assert state == "active" and lag < 5.0
+    assert wm.source_states() == {"s": "active"}
+    # stalled ACTIVE fence: lag grows (the reading the advisor alarms on)
+    wm._advanced_at -= 42.0
+    state, lag = wm.lag_state()
+    assert state == "active" and lag > 40.0
+    wm.finish("s")
+    assert wm.lag_state() == ("done", 0.0)
+    assert wm.source_states() == {"s": "done"}
+
+
+def test_lag_state_new_idle_source_after_done():
+    """The cluster-smoke shape: ingest finished, then a NEW source
+    registers. Idle until it advances; active-stalled after one advance
+    (what the straggler injection relies on)."""
+    wm = WatermarkRegistry()
+    wm.register("old")
+    wm.advance("old", 50)
+    wm.finish("old")
+    wm.register("late")
+    assert wm.lag_state() == ("idle", 0.0)          # no traffic yet
+    wm.advance("late", 10)
+    state, lag = wm.lag_state()
+    assert state == "active"
+    assert wm.safe_time() == 10                     # fence dragged down
+
+
+def test_watermark_reuse_after_finish_still_drains():
+    """The production reuse shape: a bounded source finishes (fence →
+    the 2^62 sentinel), then a NEW live source registers on the SAME
+    registry and streams. The watermark must keep reporting fence
+    movement (a pinned-high _safe_seen would freeze the freshness
+    drain and the lag clock for the registry's remaining lifetime)."""
+    wm = WatermarkRegistry()
+    wm.register("a")
+    wm.advance("a", 50)
+    wm.finish("a")                       # fence → 2^62
+    wm.register("b")                     # fence legitimately drops
+    FRESH.register_source("b")
+    FRESH.note_batch("b", _t([5, 10]), now=time.time())
+    assert FRESH.pending_batches() == 1
+    wm.advance("b", 10)                  # must register as movement
+    assert FRESH.pending_batches() == 0  # ...and drain the new source
+    q = FRESH.freshz()["sources"]["b"]["queryable_seconds"]
+    assert q["count"] == 1
+    assert FRESH.freshz()["last_safe_time"] == 10
+    # the lag clock tracks the new fence too: advancing resets it
+    state, lag = wm.lag_state()
+    assert state == "active" and lag < 5.0
+
+
+def test_router_pending_counter_matches_queue():
+    from raphtory_tpu.ingestion.router import Shard, ShardRouter
+
+    router = ShardRouter([Shard(0), Shard(1)])
+    router.shards[1].kill()
+    for i in range(3):
+        router.append_batch(_t([i, i + 10]), _k([2, 2]),
+                            _t([0, 1]), _t([1, 0]))
+    # shard 1's slices queued; the O(1) counter agrees with the scan
+    assert router.pending_events() == router.pending_events(1) == 3
+    from raphtory_tpu.core.events import EventLog
+
+    router.shards[1].log = EventLog()
+    router.revive(router.shards[1])
+    assert router.pending_events() == 0
+
+
+def test_watermark_advance_drains_freshness_queryable():
+    """The full hook: watermark advance → note_safe → queryable drain,
+    without any pipeline in the loop."""
+    wm = WatermarkRegistry()
+    wm.register("s")
+    FRESH.register_source("s")
+    FRESH.note_batch("s", _t([1, 2, 3]), now=time.time())
+    assert FRESH.pending_batches() == 1
+    wm.advance("s", 3)
+    assert FRESH.pending_batches() == 0
+    q = FRESH.freshz()["sources"]["s"]["queryable_seconds"]
+    assert q["count"] == 1
+
+
+# ---- pipeline integration: out-of-order + tombstone-heavy streams ----
+
+def _stream(shuffle):
+    """An out-of-order + tombstone-heavy update stream: adds, deletes,
+    re-adds over a small vertex set, shuffled within a disorder bound."""
+    rng = np.random.default_rng(11)
+    ups = []
+    for t in range(400):
+        a, b = int(rng.integers(0, 12)), int(rng.integers(0, 12))
+        if t % 7 == 3:
+            ups.append(EdgeDelete(t, a, b))
+        elif t % 11 == 5:
+            ups.append(VertexDelete(t, a))
+        else:
+            ups.append(EdgeAdd(t, a, b))
+    if shuffle:
+        # bounded shuffle: each event moves at most 20 positions, so a
+        # declared disorder of 40 time units safely covers it
+        ups = [ups[i] for i in
+               np.argsort(np.arange(len(ups))
+                          + rng.uniform(0, 20, len(ups)))]
+    return ups
+
+
+@pytest.mark.parametrize("staged", [False, True])
+def test_out_of_order_tombstone_pipeline_commutes(staged):
+    """The paper's commutativity story through the FULL pipeline →
+    watermark → queryable path (satellite): a disorder-shuffled,
+    tombstone-heavy stream folds to the SAME view as its in-order twin,
+    the fence ends equal, and the freshness plane saw the disorder."""
+    from raphtory_tpu.core.snapshot import build_view
+
+    views = {}
+    for label, shuffle in (("inorder", False), ("shuffled", True)):
+        FRESH.clear()
+        pipe = IngestionPipeline(
+            batch_size=32, queue_max_events=64 if staged else 0)
+        pipe.add_source(IterableSource(_stream(shuffle), name=label,
+                                       disorder=40))
+        pipe.run()
+        assert not pipe.errors
+        g = TemporalGraph(pipe.log, pipe.watermarks)
+        assert g.safe_time() >= 2**62          # all sources finished
+        v = build_view(pipe.log, 399)
+        views[label] = (int(v.n_active), int(v.m_active))
+        doc = FRESH.freshz()
+        s = doc["sources"][label]
+        assert s["events"] == 400
+        assert s["kinds"]["edge_delete"] > 0   # tombstones visible
+        assert s["tombstone_fraction"] > 0.1
+        if shuffle:
+            ooo = s["out_of_order"]
+            assert ooo["events"] > 0           # disorder visible
+            assert ooo["max_distance"] <= 40   # within the bound
+            assert ooo["past_disorder_bound"] is False
+        # every batch became queryable by the end (fence released)
+        assert s["pending_batches"] == 0
+        assert s["queryable_seconds"]["count"] > 0
+    assert views["inorder"] == views["shuffled"]
+
+
+def test_staged_and_direct_note_identical_telemetry():
+    """The bench's direct-mode protocol note: the freshness hooks stamp
+    at the sink either way — identical per-source counters."""
+    docs = {}
+    for qmax in (0, 1024):
+        FRESH.clear()
+        pipe = IngestionPipeline(batch_size=16, queue_max_events=qmax)
+        pipe.add_source(IterableSource(
+            [EdgeAdd(t, t % 5, (t + 1) % 5) for t in range(200)],
+            name="s"))
+        pipe.run()
+        s = FRESH.freshz()["sources"]["s"]
+        docs[qmax] = {k: s[k] for k in
+                      ("events", "batches", "kinds", "high_water_time")}
+        assert s["stage"] == ("staged" if qmax else "direct")
+    assert docs[0] == docs[1024]
+
+
+def test_router_stage_telemetry():
+    from raphtory_tpu.ingestion.router import Shard, ShardRouter
+
+    router = ShardRouter([Shard(0), Shard(1)])
+    router.append_batch(_t([1, 2, 3, 4]), _k([2, 2, 2, 2]),
+                        _t([0, 1, 2, 3]), _t([1, 2, 3, 0]))
+    rt = FRESH.freshz()["router"]
+    assert sum(rt["routed_events_by_shard"].values()) == 4
+    assert rt["dead_letter_events"] == 0
+    router.shards[1].kill()
+    router.append_batch(_t([5]), _k([2]), _t([1]), _t([2]))
+    rt = FRESH.freshz()["router"]
+    assert rt["dead_letter_events"] == 1   # queued for the dead shard
+
+
+# ---- series-ring collectors ----
+
+def test_series_ring_samples_freshness_signals():
+    from raphtory_tpu.obs.slo import SERIES
+
+    FRESH.register_source("s")
+    FRESH.note_batch("s", _t([1, 2, 3]), now=time.time())
+    row = SERIES.sample_once()
+    assert row["ingest_events_total"] == 3.0
+    assert row["ingest_backlog_events"] == 0.0
+    assert row["queryable_lag_seconds"] >= 0.0
+
+
+# ---- advisor rules ----
+
+def test_rule_ingest_backlog():
+    from raphtory_tpu.obs.advisor import rule_ingest_backlog
+
+    sig = {"freshness": {"backlog_events": 900, "queue_max_events": 1000,
+                         "sources": {}, "queryable_lag_seconds": 2.0}}
+    f = rule_ingest_backlog(sig)
+    assert f and f["rule_id"] == "ingest-backlog"
+    assert f["evidence"]["backlog_events"] == 900
+    # below the bar, or unbounded queue: quiet
+    sig["freshness"]["backlog_events"] = 100
+    assert rule_ingest_backlog(sig) is None
+    assert rule_ingest_backlog({"freshness": {}}) is None
+
+
+def test_rule_ingest_backlog_judges_per_queue():
+    """Saturation is a per-queue property: two half-full queues must
+    NOT fire (summed backlog vs the max bound would read 90%), while
+    one saturated queue among several MUST fire even behind another
+    queue's larger bound."""
+    from raphtory_tpu.obs.advisor import rule_ingest_backlog
+
+    two_half = {"freshness": {
+        "backlog_events": 9000, "queue_max_events": 10000,
+        "staged_queues": [
+            {"backlog_events": 4500, "queue_max_events": 10000},
+            {"backlog_events": 4500, "queue_max_events": 10000}],
+        "sources": {}}}
+    assert rule_ingest_backlog(two_half) is None
+    one_pinned = {"freshness": {
+        "backlog_events": 1000, "queue_max_events": 100000,
+        "staged_queues": [
+            {"backlog_events": 950, "queue_max_events": 1000},
+            {"backlog_events": 50, "queue_max_events": 100000}],
+        "sources": {}}}
+    f = rule_ingest_backlog(one_pinned)
+    assert f and f["evidence"]["backlog_events"] == 950
+    assert f["evidence"]["queue_max_events"] == 1000
+
+
+def test_rule_ooo_excess():
+    from raphtory_tpu.obs.advisor import rule_ooo_excess
+
+    src = {"events": 5000, "disorder_bound": 10, "ooo_max": 500,
+           "ooo_events": 100}
+    f = rule_ooo_excess({"freshness": {"sources": {"kafka": src}}})
+    assert f and f["rule_id"] == "out-of-order-excess"
+    assert f["evidence"]["source"] == "kafka"
+    # within the declared bound: quiet
+    src2 = dict(src, ooo_max=9)
+    assert rule_ooo_excess(
+        {"freshness": {"sources": {"kafka": src2}}}) is None
+    # evidence floor: too few events
+    src3 = dict(src, events=10)
+    assert rule_ooo_excess(
+        {"freshness": {"sources": {"kafka": src3}}}) is None
+
+
+def test_rule_freshness_burn():
+    from raphtory_tpu.obs.advisor import rule_freshness_burn
+
+    sig = {"freshness": {"budget": {
+        "grade": "burning",
+        "targets": [{"algorithm": "pagerank", "grade": "burning"}]},
+        "staleness_p99_seconds": {"PageRank": 30.0}}}
+    f = rule_freshness_burn(sig)
+    assert f and f["rule_id"] == "freshness-burn"
+    sig["freshness"]["budget"]["grade"] = "ok"
+    assert rule_freshness_burn(sig) is None
+
+
+def test_freshness_rules_registered_and_quiet_when_healthy():
+    from raphtory_tpu.obs.advisor import RULES, evaluate_rules
+
+    ids = {rid for rid, _, _, _ in RULES}
+    assert {"ingest-backlog", "out-of-order-excess",
+            "freshness-burn"} <= ids
+    # a healthy signals dict fires none of the freshness rules
+    sig = {"freshness": FRESH.advisor_signals(), "queries": [],
+           "env": {}, "budget": {"grade": "ok"}}
+    fired = {f["rule_id"] for f in evaluate_rules(sig)}
+    assert not ({"ingest-backlog", "out-of-order-excess",
+                 "freshness-burn"} & fired)
+
+
+# ---- REST e2e: live query → /freshz exemplar → /tracez; /clusterz ----
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=15) as r:
+        return json.loads(r.read().decode())
+
+
+def _post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+class _SlowSource(Source):
+    """A live streaming source: trickles batches with small sleeps so a
+    concurrent Live query observes a MOVING ingest head."""
+
+    name = "live-stream"
+    disorder = 0
+
+    def __iter__(self):
+        for t in range(0, 240):
+            yield EdgeAdd(t, t % 9, (t + 1) % 9)
+            if t % 40 == 39:
+                time.sleep(0.05)
+
+
+def test_e2e_live_query_freshz_exemplar_resolves_at_tracez(traced):
+    """ISSUE-15 acceptance: a live query over a streaming source lands
+    staleness observations on /freshz whose exemplar trace id resolves
+    at /tracez."""
+    from raphtory_tpu.jobs.manager import AnalysisManager
+    from raphtory_tpu.jobs.rest import RestServer
+
+    pipe = IngestionPipeline(batch_size=16)
+    pipe.add_source(_SlowSource())
+    g = TemporalGraph(pipe.log, pipe.watermarks)
+    mgr = AnalysisManager(g)
+    srv = RestServer(mgr, port=0).start()
+    try:
+        pipe.start()                       # stream WHILE the live job runs
+        time.sleep(0.05)                   # let the head exist
+        sub = _post(srv.port, "/LiveAnalysisRequest",
+                    {"analyserName": "DegreeBasic", "repeatTime": 0.05,
+                     "maxRuns": 4})
+        job = mgr.get(sub["jobID"])
+        assert job.wait(60) and job.status == "done", job.error
+        pipe.join(30)
+        fz = _get(srv.port, "/freshz")
+        # the streaming source's telemetry is on the per-source table
+        assert fz["sources"]["live-stream"]["events"] == 240
+        hist = fz["staleness_seconds"]["DegreeBasic"]
+        assert hist["count"] >= 4
+        ex = hist["p99_exemplar"]
+        assert ex and ex["trace_id"], hist
+        assert ex["trace_id"] == sub["traceID"]
+        # ... and the exemplar resolves to actual spans at /tracez
+        tz = _get(srv.port, f"/tracez?trace_id={ex['trace_id']}")
+        assert tz["spans"], "exemplar trace id resolved to no spans"
+        assert any(s["name"] == "job" for s in tz["spans"])
+        # the compact block rides /statusz
+        st = _get(srv.port, "/statusz")
+        assert st["freshness"]["sources"] >= 1
+        assert "DegreeBasic" in st["freshness"]["staleness_p99_seconds"]
+    finally:
+        pipe.stop(5)
+        srv.stop()
+
+
+class _FakePeer:
+    """A canned /statusz peer: what a second process's snapshot looks
+    like to the /clusterz merger (the REAL 2-process path is proven by
+    tools/cluster_smoke.py in CI)."""
+
+    def __init__(self, statusz):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        doc = json.dumps(statusz).encode()
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(doc)))
+                self.end_headers()
+                self.wfile.write(doc)
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_clusterz_merges_freshness_from_two_processes(monkeypatch):
+    """ISSUE-15 acceptance: /clusterz merges the freshness block from
+    >= 2 processes — merged min-watermark, per-process safe times and
+    the watermark spread."""
+    from raphtory_tpu.jobs.manager import AnalysisManager
+    from raphtory_tpu.jobs.rest import RestServer
+    from raphtory_tpu.obs.cluster import SCRAPER
+
+    pipe = IngestionPipeline()
+    pipe.add_source(IterableSource(
+        [EdgeAdd(t, t % 4, (t + 1) % 4) for t in range(50)], name="s"))
+    pipe.run()
+    g = TemporalGraph(pipe.log, pipe.watermarks)
+    mgr = AnalysisManager(g)
+    srv = RestServer(mgr, port=0).start()
+    peer = _FakePeer({
+        "reachable": True, "jobs": {},
+        "cluster": {"process_index": 1, "ports": {}},
+        "watermark": {"safe_time": 17, "lag_seconds": 42.0,
+                      "sources": {"remote": 17}},
+        "log_events": 10,
+        "freshness": {"enabled": True, "sources": 1,
+                      "updates_per_s": 123.0, "backlog_events": 7,
+                      "pending_batches": 0,
+                      "queryable_lag_seconds": 0.5,
+                      "staleness_p99_seconds": {}, "grade": "ok"},
+    })
+    try:
+        monkeypatch.setenv(
+            "RTPU_CLUSTER_PEERS",
+            f"127.0.0.1:{srv.port},127.0.0.1:{peer.port}")
+        SCRAPER.clear()
+        cz = _get(srv.port, "/clusterz?refresh=1")
+        fz = cz["freshness"]
+        # both processes federate into the lag map; the local all-done
+        # fence sits at the 2^62 sentinel, which the merge filters from
+        # the safe-time map (a sentinel is not a time) — the merged
+        # min-watermark is the lagging shard's finite fence
+        assert set(fz["watermark_lag_by_process"]) == {"process_0",
+                                                       "process_1"}
+        assert set(fz["safe_time_by_process"]) == {"process_1"}
+        assert fz["min_safe_time"] == 17
+        assert fz["min_safe_process"] == "process_1"
+        # spread: 42.0 (peer) vs 0.0 (local, done)
+        assert fz["watermark_spread_seconds"] == pytest.approx(42.0)
+        assert fz["updates_per_s_total"] >= 123.0
+        assert fz["backlog_events_total"] == 7
+        assert cz["processes"]["process_1"]["freshness"][
+            "updates_per_s"] == 123.0
+    finally:
+        peer.stop()
+        srv.stop()
+
+
+def test_freshz_dump_writes_document_at_exit(tmp_path):
+    """The RTPU_FRESH_DUMP CI-artifact hook: a process that ingested
+    writes a loadable /freshz document at interpreter exit."""
+    import os
+    import subprocess
+    import sys
+
+    path = tmp_path / "freshz.json"
+    code = (
+        "import numpy as np\n"
+        "from raphtory_tpu.obs.freshness import FRESH\n"
+        "FRESH.note_batch('s', np.asarray([1, 2, 3], np.int64))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, "RTPU_FRESH_DUMP": str(path),
+             "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-1000:]
+    doc = json.loads(path.read_text())
+    assert doc["sources"]["s"]["events"] == 3

@@ -18,10 +18,14 @@ proves the ISSUE-10 acceptance path end to end:
 * each worker's job carries its own ``X-RTPU-Tenant`` identity and the
   merged ``/clusterz`` workload view must show BOTH tenant accounts
   with per-process attribution (ISSUE-11);
-* finally worker 1 is DELAYED (a live source stops feeding, stalling
-  its watermark fence) and one federated ``/advisez`` pass on worker 0
-  must fire the ``cluster-straggler`` rule naming process 1 (ISSUE-11:
-  the advisor's distributed story).
+* finally worker 1 is DELAYED (a live source advances once then stops
+  feeding, stalling its watermark fence — ACTIVE-stalled, not idle,
+  per the ISSUE-15 lag_state semantics) and one federated ``/advisez``
+  pass on worker 0 must fire the ``cluster-straggler`` rule naming
+  process 1 (ISSUE-11: the advisor's distributed story);
+* the merged ``/clusterz`` freshness block (ISSUE-15) must carry both
+  processes' safe times + watermark spread, and the delayed worker's
+  source must MOVE the merged min-watermark to its stalled fence.
 
 The federated snapshot is written to ``--out`` (the CI failure
 artifact). Exit 0 prints CLUSTERZ_OK; any assertion prints the evidence
@@ -168,7 +172,17 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
                 raise TimeoutError("no driver_done sentinel")
             if not injected and os.path.exists(
                     os.path.join(tmpdir, "make_straggler")):
+                # a source that advanced ONCE then stalls: under the
+                # idle/active watermark semantics (ISSUE-15) a
+                # registered-but-never-advanced source is IDLE (no
+                # traffic ≠ stalled) and must not alarm — the straggler
+                # has to have streamed. The single low advance also
+                # drags this process's safe_time down to 10, which is
+                # exactly what must move the merged /clusterz
+                # min-watermark.
                 graph.watermarks.register("stalled-smoke")
+                graph.watermarks.advance("stalled-smoke", 10)
+                assert graph.watermarks.lag_state()[0] == "active"
                 injected = True
                 with open(os.path.join(tmpdir, "straggler_up"), "w") as f:
                     f.write("ok")
@@ -233,6 +247,22 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
     with_spans = czt["trace"]["processes_with_spans"]
     assert set(with_spans) >= {"process_0", "process_1"}, (
         f"trace {tid} not reassembled from both processes: {with_spans}")
+
+    # ---- freshness plane in the MERGED view (ISSUE-15): both
+    # processes' ingest telemetry federates — per-process safe times,
+    # watermark spread, and a merged min-watermark (moved by the
+    # straggler phase below)
+    fz = cz["freshness"]
+    assert {"process_0", "process_1"} <= set(
+        fz["watermark_lag_by_process"]), fz
+    assert "watermark_spread_seconds" in fz, fz
+    # both replays finished: every fence sits at the all-done sentinel,
+    # which the merge renders as null (not 4611686018427387904)
+    assert fz["min_safe_time"] is None, fz
+    for name, p in procs.items():
+        fr = p.get("freshness") or {}
+        assert fr.get("sources", 0) >= 1, (name, fr)
+        assert "queryable_lag_seconds" in fr, (name, fr)
 
     # ---- per-tenant accounts in the MERGED mesh view (ISSUE-11):
     # each worker's job landed in its own tenant account, attributed to
@@ -339,6 +369,26 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
     assert ev["watermark_lag_by_process"]["process_1"] > \
         ev["watermark_lag_by_process"]["process_0"], ev
     print("STRAGGLER_OK", flush=True)
+
+    # ---- the delayed worker's source MOVES the merged min-watermark
+    # (ISSUE-15): worker 1's stalled source advanced once to 10, so its
+    # safe_time — and therefore the cluster's merged min — is 10, and
+    # the per-process watermark spread shows the lagging ingest shard
+    # the barrier-wait straggler signals cannot see
+    cz2 = _http_json(f"{me}/clusterz?refresh=1")
+    fz2 = cz2["freshness"]
+    # the stalled source MOVED the merged min-watermark: null (all
+    # done) → the delayed worker's finite fence
+    assert fz2["min_safe_time"] == 10, fz2
+    assert fz2["min_safe_process"] == "process_1", fz2
+    assert fz2["watermark_spread_seconds"] > 0, fz2
+    if out:   # the artifact keeps the moved-min-watermark evidence too
+        with open(out, "w") as f:
+            json.dump({"clusterz": cz, "trace": czt["trace"],
+                       "trace_id": tid, "advisez": az,
+                       "clusterz_post_straggler": cz2}, f, indent=1,
+                      default=str)
+    print("FRESHNESS_OK", flush=True)
 
     with open(sentinel, "w") as f:
         f.write("ok")
