@@ -281,8 +281,10 @@ def _check_broad_except_retry(mod: Module) -> list[Finding]:
         out.append(mod.finding(
             "RT002", node,
             "broad except inside a sleep/backoff loop hides programming "
-            "errors behind the full retry schedule — classify (re-raise "
-            "non-transient) like utils/transfer._is_transient"))
+            "errors behind the full retry schedule — use "
+            "resilience/policy.RetryPolicy.run (classified, jittered, "
+            "deadline-aware) or classify with "
+            "resilience.policy.default_classify and re-raise non-transient"))
     return out
 
 

@@ -50,6 +50,7 @@ from ..core.sweep import _ENC_MASK, _ENC_SHIFT, SweepBuilder
 from ..native import lib as _native
 from ..obs import ledger as _ledger
 from ..obs.trace import TRACER
+from ..resilience import faults as _faults
 from ..utils.transfer import _metrics
 from .bsp import make_mask_runner
 from .program import VertexProgram
@@ -592,6 +593,12 @@ class DeviceSweep:
             windows = [window if window is not None else -1]
         wlist = normalize_windows(windows)
 
+        # the device.dispatch failpoint: an injected error propagates
+        # through the same except paths a real dispatch failure takes
+        # (run_sweep marks _stale; the next hop rewinds through the
+        # full-refresh recovery) — chaos runs exercise recovery, not a
+        # parallel code path
+        _faults.fire("device.dispatch")
         runner = _compiled_run(program, self.n_pad, self.m_pad, len(wlist),
                                np.dtype(self.tdtype).name)
         with TRACER.span("hop.compute", time=int(T), windows=len(wlist),

@@ -27,6 +27,7 @@ import numpy as np
 
 from ..core.events import EventLog
 from ..obs import freshness as _fresh
+from ..resilience import faults as _faults
 
 __all__ = ["Shard", "ShardDownError", "ShardRouter", "merge_logs"]
 
@@ -169,8 +170,13 @@ class ShardRouter:
     def _deliver(self, shard: Shard, sl: tuple) -> None:
         try:
             self._drain(shard)             # keep arrival order on rejoin
+            # the ingest.sink failpoint: an injected fault takes the
+            # SAME dead-letter path a down shard takes — the slice
+            # queues and replays on the next delivery/revive, proving
+            # the buffering story rather than bypassing it
+            _faults.fire("ingest.sink")
             shard.append_batch(*sl)
-        except ShardDownError:
+        except (ShardDownError, _faults.FaultError):
             with self._lock:
                 self._pending.setdefault(shard.id, []).append(sl)
                 self._pending_n += len(sl[0])

@@ -20,6 +20,7 @@ import time as _time
 from ..obs import freshness as _fresh
 from ..obs.metrics import METRICS
 from ..obs.trace import TRACER
+from ..resilience import faults as _faults
 
 _NEG_INF = -(2**62)
 
@@ -48,6 +49,10 @@ class WatermarkRegistry:
             self._marks.setdefault(source, _NEG_INF)
 
     def advance(self, source: str, watermark: int) -> None:
+        # the watermark.advance failpoint fires BEFORE the lock: an
+        # injected error/hang stalls this source's fence exactly like a
+        # wedged feeder would, without poisoning registry state
+        _faults.fire("watermark.advance")
         advanced = False
         with self._lock:
             cur = self._marks.get(source, _NEG_INF)

@@ -30,6 +30,8 @@ from ..obs import slo as _slo
 from ..obs import workload as _workload
 from ..obs.metrics import METRICS
 from ..obs.trace import TRACER, block_steps as _block_steps
+from ..resilience import degrade as _degrade
+from ..resilience.policy import default_classify as _transient
 
 import logging
 
@@ -146,6 +148,13 @@ class Job:
         self.results_dropped = 0
         self.status = "pending"
         self.error: str | None = None
+        #: degraded serving (resilience/degrade.py): a range sweep whose
+        #: deadline or retry budget expired MID-sweep ships the hops it
+        #: covered, status "done", with these three fields telling the
+        #: client exactly how much of the range the answer covers
+        self.degraded = False
+        self.covered_time: int | None = None
+        self.degraded_reason: str | None = None
         self._kill = threading.Event()
         self._thread: threading.Thread | None = None
         self._done = threading.Event()
@@ -715,25 +724,73 @@ class Job:
     def _range_amortised(self, q: RangeQuery, advance, run, freeze_rv) -> None:
         """The shared amortised-sweep hop loop: advance the fold, dispatch
         async, emit the PREVIOUS hop while this one computes (hop i+1's host
-        fold overlaps hop i's device supersteps)."""
+        fold overlaps hop i's device supersteps).
+
+        Degraded serving (docs/RESILIENCE.md): a deadline that expires or
+        a transient failure that exhausts its retry budget MID-sweep stops
+        the loop but ships every hop already covered — the job finishes
+        "done" with ``degraded: true`` and ``covered_time`` instead of
+        discarding paid-for work. Pre-dispatch expiry (nothing covered)
+        still fails fast in ``_run_query``, and non-transient errors still
+        fail the job: a wrong answer is not a degraded answer."""
         pending = None
+        covered = None
+        reason = None
         t = q.start
         while t <= q.end and not self._kill.is_set():
+            if (self.deadline is not None
+                    and _time.monotonic() > self.deadline
+                    and (pending is not None or covered is not None)):
+                reason = "deadline"
+                break
             t0 = _time.perf_counter()
             s0 = _time.perf_counter()
-            advance(int(t))
-            METRICS.snapshot_build_seconds.observe(_time.perf_counter() - s0)
-            self.ledger.add_phase("fold", _time.perf_counter() - s0)
-            windows = list(q.windows) if q.windows is not None else None
-            result, steps = run(windows)
-            rv = freeze_rv()
+            try:
+                advance(int(t))
+                METRICS.snapshot_build_seconds.observe(
+                    _time.perf_counter() - s0)
+                self.ledger.add_phase("fold", _time.perf_counter() - s0)
+                windows = list(q.windows) if q.windows is not None else None
+                result, steps = run(windows)
+                rv = freeze_rv()
+            except Exception as e:
+                if (_transient(e)
+                        and (pending is not None or covered is not None)):
+                    reason = "retry_budget"
+                    break
+                raise
             t_disp = _time.perf_counter()
             if pending is not None:
                 self._emit_mesh(*pending)
+                covered = pending[0]
             pending = (t, q, rv, result, steps, t0, t_disp)
             t += q.jump
         if pending is not None:
-            self._emit_mesh(*pending)
+            try:
+                self._emit_mesh(*pending)
+                covered = pending[0]
+            except Exception as e:
+                # the tail hop's buffers may be poisoned by the same
+                # transient failure that stopped the loop — a degraded
+                # answer keeps the PRIOR covered hops rather than dying
+                # on the flush; a healthy run still propagates
+                if reason is None or not _transient(e):
+                    raise
+        if reason is not None:
+            self._mark_degraded(reason, covered)
+
+    def _mark_degraded(self, reason: str, covered) -> None:
+        """Record a partial answer: job-side fields the REST payload
+        surfaces, plus the process-wide ledger /healthz and /faultz grade
+        from. Never fails the job it is marking."""
+        self.degraded = True
+        self.covered_time = None if covered is None else int(covered)
+        self.degraded_reason = reason
+        try:
+            _degrade.DEGRADED.note(self.id, reason,
+                                   covered_time=self.covered_time)
+        except Exception:   # telemetry must not fail a served answer
+            pass
 
     def _emit_mesh(self, t, q, rv, result, steps, t0, t_disp) -> None:
         import jax

@@ -57,6 +57,7 @@ as ONE trace.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -70,12 +71,27 @@ from ..obs import workload as _workload
 from ..obs.advisor import ADVISOR
 from ..obs.sampler import SAMPLER
 from ..obs.trace import TRACER, TraceContext
+from ..resilience import faults as _faults
 from ..utils.config import process_index, strided_port
 from . import registry
 from . import scheduler as _scheduler
 from .manager import AnalysisManager, LiveQuery, RangeQuery, ViewQuery
 
 DEFAULT_PORT = 8081
+
+
+def rest_conn_timeout_s() -> float | None:
+    """``RTPU_REST_CONN_TIMEOUT_S`` — per-connection socket timeout. A
+    half-open client (connected, never finishes its request, or stops
+    reading the response) used to pin one ``rest-req-*`` handler thread
+    FOREVER; with the timeout the blocked read/write raises, the
+    connection closes, and the thread returns to the pool. ``0``
+    disables (the old behaviour)."""
+    try:
+        v = float(os.environ.get("RTPU_REST_CONN_TIMEOUT_S", "") or 30.0)
+    except ValueError:
+        v = 30.0
+    return None if v <= 0 else v
 
 
 class _BadParam(ValueError):
@@ -230,6 +246,9 @@ def _statusz(manager: AnalysisManager,
         # totals, the memory snapshot (or its honest degrade), resident
         # bytes, and the compile-storm signal — what /clusterz federates
         "device": _device.status_block(),
+        # the resilience plane (resilience/): armed failpoints, breaker
+        # states, degraded-results tally — the full document is /faultz
+        "resilience": _resilience_block(),
         # the distributed half: which process this is, where its
         # listeners actually bound (what /clusterz discovery reads), and
         # what the cross-shard collectives moved
@@ -241,6 +260,22 @@ def _statusz(manager: AnalysisManager,
         status["latest_time"] = None
     status["collectives"] = COLLECTIVES.snapshot()
     return status
+
+
+def _resilience_block() -> dict:
+    """The compact ``resilience`` block of /statusz (federated by
+    /clusterz): enough for the merged view to see injected chaos, open
+    breakers, and degraded serves without fetching every /faultz."""
+    doc = _faults.faultz()
+    return {
+        "faults_enabled": doc["enabled"],
+        "armed_sites": sorted(doc["sites"]),
+        "injected": sum(s["injected"] for s in doc["sites"].values()),
+        "breakers_open": sorted(
+            name for name, b in doc["breakers"].items()
+            if b["state"] != "closed"),
+        "degraded_results": doc["degraded"].get("total", 0),
+    }
 
 
 def _cluster_block(handler=None) -> dict:
@@ -333,6 +368,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _post(self, rsp):
         try:
+            # the rest.handler failpoint: an injected error terminates
+            # HONESTLY as a classified 503 with evidence (the chaos
+            # bench's zero-unclassified-500s bar), never a bare 500
+            _faults.fire("rest.handler")
             n = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(n) or b"{}")
             path = self.path.rstrip("/")
@@ -409,6 +448,12 @@ class _Handler(BaseHTTPRequestHandler):
                  "evidence": e.evidence,
                  "retryAfterSeconds": e.retry_after_s},
                 headers={"Retry-After": str(int(e.retry_after_s))})
+        except _faults.FaultError as e:
+            rsp.set(injected=True)
+            self._json(503, {"error": f"FaultError: {e}",
+                             "injected": True,
+                             "evidence": {"site": "rest.handler"}},
+                       headers={"Retry-After": "1"})
         except (KeyError, ValueError, TypeError) as e:
             self._json(400, {"error": f"{type(e).__name__}: {e}"})
         except Exception as e:  # noqa: BLE001
@@ -490,6 +535,11 @@ class _Handler(BaseHTTPRequestHandler):
             parsed = urllib.parse.urlparse(self.path)
             qs = urllib.parse.parse_qs(parsed.query)
             path = parsed.path.rstrip("/")
+            if path != "/faultz":
+                # rest.handler failpoint (GET side) — /faultz itself is
+                # exempt so the chaos run's own evidence endpoint stays
+                # readable while every other route is being failed
+                _faults.fire("rest.handler")
             if path == "/AnalysisResults":
                 job = self.manager.get(qs["jobID"][0])
                 payload = {
@@ -502,6 +552,13 @@ class _Handler(BaseHTTPRequestHandler):
                 if job.trace_id:
                     # the request's trace: /tracez?trace_id=<this>
                     payload["traceID"] = job.trace_id
+                if getattr(job, "degraded", False):
+                    # the degraded-serving contract: PARTIAL results,
+                    # honestly marked, with the watermark the sweep
+                    # actually covered (docs/RESILIENCE.md)
+                    payload["degraded"] = True
+                    payload["coveredTime"] = job.covered_time
+                    payload["degradedReason"] = job.degraded_reason
                 if job.results_dropped:
                     # oldest rows rolled off the RTPU_RESULT_ROWS cap —
                     # the sink file (when configured) has the full set
@@ -559,12 +616,22 @@ class _Handler(BaseHTTPRequestHandler):
                     200, _slo.slz_payload(_num_param(qs, "n", 120, int)))
             if path == "/profilez":
                 return self._profilez(qs)
+            if path == "/faultz":
+                # the resilience plane (resilience/): armed failpoints
+                # with injection counts, per-peer breaker states, the
+                # degraded-results ledger — docs/RESILIENCE.md
+                return self._json(200, _faults.faultz())
             if path == "/workloadz":
                 # per-tenant workload accounts (obs/workload.py)
                 return self._json(200, _workload.WORKLOAD.workloadz())
             if path == "/advisez":
                 return self._advisez(qs)
             return self._json(404, {"error": f"unknown path {self.path}"})
+        except _faults.FaultError as e:
+            self._json(503, {"error": f"FaultError: {e}",
+                             "injected": True,
+                             "evidence": {"site": "rest.handler"}},
+                       headers={"Retry-After": "1"})
         except KeyError as e:
             self._json(404, {"error": f"KeyError: {e}"})
         except _BadParam as e:
@@ -584,7 +651,13 @@ class RestServer:
                  watchdog=None):
         handler = type("Handler", (_Handler,),
                        {"manager": manager, "allow_dynamic": allow_dynamic,
-                        "watchdog": watchdog})
+                        "watchdog": watchdog,
+                        # per-connection socket timeout (stdlib
+                        # StreamRequestHandler honours the class attr in
+                        # setup()): a half-open client's blocked read or
+                        # write raises instead of pinning a rest-req-*
+                        # thread forever
+                        "timeout": rest_conn_timeout_s()})
         # stride the listen port by jax.process_index() so an N-process
         # localhost cluster never collides on :8081 (RTPU_PORT_STRIDE;
         # port 0 stays ephemeral, process 0 binds the base verbatim)

@@ -71,6 +71,7 @@ from ..obs import ledger as _ledger
 from ..obs import workload as _workload
 from ..obs.metrics import METRICS
 from ..obs.trace import TRACER
+from ..resilience import faults as _faults
 
 _log = logging.getLogger(__name__)
 
@@ -595,6 +596,10 @@ class ServingScheduler:
                              hops=len(hops), windows=len(wlist),
                              cols=total_cols), \
                     _ledger.activate(led):
+                # the sched.dispatch failpoint: an injected failure
+                # rides the existing decline path — every member falls
+                # back to its solo route, availability costs nothing
+                _faults.fire("sched.dispatch")
                 ranks, steps = hb.run(hops, wlist, chunks=1,
                                       hop_callback=grab_shell)
                 ranks = np.asarray(ranks)

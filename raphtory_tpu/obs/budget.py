@@ -433,5 +433,20 @@ def healthz() -> tuple[int, dict]:
         if _GRADE_ORDER[fr["grade"]] > _GRADE_ORDER[grade]:
             grade = fr["grade"]
             payload["status"] = grade
+    try:   # same lazy-join contract as freshness: the resilience plane
+        from ..resilience.degrade import DEGRADED   # must not kill probes
+
+        recent = DEGRADED.recent(fast_window_s())
+    except Exception:
+        recent = 0
+    if recent:
+        # partial answers served inside the fast window: the process is
+        # up but shedding coverage — at most "degraded" (a breaker doing
+        # its job is not a 503-worthy burn; sustained latency/staleness
+        # breaches still grade "burning" through their own budgets)
+        payload["degraded_results_recent"] = recent
+        if _GRADE_ORDER[grade] < _GRADE_ORDER["degraded"]:
+            grade = "degraded"
+            payload["status"] = grade
     code = 503 if grade == "burning" and ev["strict"] else 200
     return code, payload

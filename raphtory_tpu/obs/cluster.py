@@ -48,8 +48,15 @@ import urllib.parse
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 
+from ..resilience import faults as _faults
+from ..resilience.breaker import BREAKERS
 from ..utils.config import process_index, strided_port
 from .trace import TRACER, TraceContext
+
+
+def _breakers():
+    """The process-wide breaker registry (one name per peer URL)."""
+    return BREAKERS
 
 DEFAULT_TIMEOUT_S = 2.0
 _CACHE_MAX = 64          # bounded peer-snapshot cache (RT011)
@@ -138,6 +145,7 @@ def _fetch_json(url: str, timeout: float) -> dict:
     context rides the X-RTPU-Trace header so the serve side joins the
     scrape's trace. Raises on any transport/parse trouble — the caller
     turns that into an ``unreachable`` row, never a 500."""
+    _faults.fire("peer.scrape")
     req = urllib.request.Request(url)
     ctx = TRACER.capture()
     if ctx is not None:
@@ -169,6 +177,14 @@ class PeerScraper:
             return {u: snap for u, (ts, snap) in self._cache.items()
                     if u in urls and now - ts <= self._ttl_s}
 
+    def last_seen_s(self, url: str) -> float | None:
+        """Seconds since ``url`` last answered a cacheable scrape (past
+        the TTL too) — the staleness a DOWN peer's row renders while the
+        survivor keeps serving."""
+        with self._lock:
+            ent = self._cache.get(url)
+        return None if ent is None else time.monotonic() - ent[0]
+
     def _store(self, results: dict[str, dict]) -> None:
         now = time.monotonic()
         with self._lock:
@@ -199,22 +215,51 @@ class PeerScraper:
             todo = [u for u in todo if u not in hit]
         if todo:
             fetched: dict[str, dict] = {}
-            with TRACER.span("rest.scrape", peers=len(todo), path=path,
-                             process=TRACER.process_index):
-                # network fan-out: no lock held anywhere in this block
-                with ThreadPoolExecutor(
-                        max_workers=min(8, len(todo))) as pool:
-                    futs = {u: pool.submit(_fetch_json, u + path, timeout)
-                            for u in todo}
-                    for u, fut in futs.items():
-                        try:
-                            snap = fut.result()
-                            snap.setdefault("reachable", True)
-                            fetched[u] = snap
-                        except Exception as e:   # dead peer == data
-                            fetched[u] = {
-                                "reachable": False,
-                                "error": f"{type(e).__name__}: {e}"[:200]}
+            # per-peer circuit breakers: a DEAD peer costs `threshold`
+            # timeouts once, then one half-open probe per window — every
+            # gated pass renders the breaker as the row's evidence
+            # instead of paying the socket timeout again
+            wired = []
+            for u in todo:
+                br = _breakers().get(u)
+                if br.allow():
+                    wired.append(u)
+                else:
+                    snap = {"reachable": False, "down": True,
+                            "error": "breaker open: peer skipped this "
+                                     "pass (no timeout paid)",
+                            "breaker": br.snapshot()}
+                    seen = self.last_seen_s(u)
+                    if seen is not None:
+                        snap["last_seen_seconds_ago"] = round(seen, 3)
+                    fetched[u] = snap
+            if wired:
+                with TRACER.span("rest.scrape", peers=len(wired),
+                                 path=path,
+                                 process=TRACER.process_index):
+                    # network fan-out: no lock held anywhere in this block
+                    with ThreadPoolExecutor(
+                            max_workers=min(8, len(wired))) as pool:
+                        futs = {u: pool.submit(_fetch_json, u + path,
+                                               timeout)
+                                for u in wired}
+                        for u, fut in futs.items():
+                            try:
+                                snap = fut.result()
+                                snap.setdefault("reachable", True)
+                                fetched[u] = snap
+                                _breakers().get(u).record(True)
+                            except Exception as e:   # dead peer == data
+                                err = f"{type(e).__name__}: {e}"[:200]
+                                br = _breakers().get(u)
+                                br.record(False, error=err)
+                                snap = {"reachable": False, "error": err,
+                                        "breaker": br.snapshot()}
+                                seen = self.last_seen_s(u)
+                                if seen is not None:
+                                    snap["last_seen_seconds_ago"] = (
+                                        round(seen, 3))
+                                fetched[u] = snap
             out.update(fetched)
             if cacheable:
                 self._store({u: s for u, s in fetched.items()
@@ -233,7 +278,13 @@ def _peer_summary(status: dict) -> dict:
     """The compact per-process row of the merged view, extracted from one
     peer's /statusz snapshot (tolerant: older peers may lack blocks)."""
     if not status.get("reachable", True):
-        return {"reachable": False, "error": status.get("error", "")}
+        row = {"reachable": False, "error": status.get("error", "")}
+        # breaker evidence survives the summary: the merged view is where
+        # operators look first, so auto-down must be visible THERE
+        for k in ("down", "breaker", "last_seen_seconds_ago"):
+            if k in status:
+                row[k] = status[k]
+        return row
     cluster = status.get("cluster", {}) or {}
     jobs = status.get("jobs", {}) or {}
     coll = status.get("collectives", {}) or {}

@@ -356,6 +356,22 @@ class Metrics:
             "Ledger-priced cost seconds admitted but not yet completed "
             "(the admission-control pressure signal)", registry=r)
         self.scheduler_backlog_seconds.set_function(_scheduler_backlog)
+        # resilience plane (resilience/): retry decisions, breaker
+        # states, degraded serves — see docs/RESILIENCE.md
+        self.retry_attempts = Counter(
+            "raphtory_retry_attempts_total",
+            "Retry-policy decisions, by failpoint site and outcome "
+            "(retry, fatal, exhausted, deadline). Nothing increments on "
+            "the zero-failure hot path", ["site", "outcome"], registry=r)
+        self.breaker_state = Gauge(
+            "raphtory_breaker_state",
+            "Per-peer circuit-breaker state: 0 closed, 1 half-open, "
+            "2 open", ["peer"], registry=r)
+        self.degraded_results = Counter(
+            "raphtory_degraded_results_total",
+            "Queries answered with PARTIAL results under the degraded-"
+            "serving contract (degraded:true + coveredTime), by reason "
+            "(deadline, retry_budget)", ["reason"], registry=r)
         # advisor plane (obs/advisor.py): strictly read-only findings
         self.advisor_findings = Gauge(
             "raphtory_advisor_findings",

@@ -56,33 +56,16 @@ import numpy as np
 
 from ..analysis.sanitizer import (note_shared as _san_note,
                                   track_shared as _san_track)
+from ..resilience import faults as _faults
+from ..resilience.policy import (PROGRAMMING_MARKERS as _PROGRAMMING_MARKERS,
+                                 TRANSIENT_MARKERS as _TRANSIENT_MARKERS,
+                                 RetryPolicy, note_attempt)
 
 _log = logging.getLogger(__name__)
 
-#: status strings that mark a TRANSPORT failure worth retrying. Everything
-#: else (INVALID_ARGUMENT shape/dtype bugs, genuine RESOURCE_EXHAUSTED OOM)
-#: re-raises immediately — retrying a programming error used to burn ~70 s
-#: of exponential backoff per chunk before the real traceback surfaced.
-_TRANSIENT_MARKERS = (
-    "UNAVAILABLE",
-    "DEADLINE_EXCEEDED",
-    "ABORTED",
-    "CANCELLED",
-    "Connection reset",
-    "connection reset",
-    "Socket closed",
-    "socket closed",
-)
-
-#: XLA runtime statuses that are definitely NOT transport flaps even when
-#: raised as XlaRuntimeError
-_PROGRAMMING_MARKERS = (
-    "INVALID_ARGUMENT",
-    "RESOURCE_EXHAUSTED",
-    "UNIMPLEMENTED",
-    "NOT_FOUND",
-    "FAILED_PRECONDITION",
-)
+# The classification marker tuples live in resilience/policy.py now (the
+# one retry policy every loop derives from); the local names survive for
+# the tests that pin them.
 
 
 def _is_transient(e: BaseException) -> bool:
@@ -234,6 +217,12 @@ class TransferEngine:
         self.backoff = float(backoff)
         self.device = device
         self.stats = TransferStats()
+        # the shared policy supplies CAPPED, FULL-JITTER backoff waits:
+        # N engines retrying the same dead tunnel no longer wake in
+        # lockstep and re-stampede it (docs/RESILIENCE.md)
+        self.policy = RetryPolicy(attempts=self.retries,
+                                  base_s=self.backoff,
+                                  classify=_is_transient)
 
     # ---- slice lifecycle ----
 
@@ -268,6 +257,7 @@ class TransferEngine:
             m.h2d_bytes.inc(staged.nbytes)
             m.h2d_slices.inc()
         try:
+            _faults.fire("transfer.wire")
             return jax.device_put(staged, self.device), staged
         except Exception as e:  # noqa: BLE001 — classified below
             if not _is_transient(e):
@@ -275,16 +265,18 @@ class TransferEngine:
             return self._retry(staged, e), None   # completed synchronously
 
     def _retry(self, staged, first_err):
-        """Blocking re-put of one staged slice with exponential backoff —
-        attempt 1 (the pipelined issue) already failed."""
+        """Blocking re-put of one staged slice under the shared policy's
+        capped full-jitter backoff — attempt 1 (the pipelined issue)
+        already failed."""
         import jax
 
         err = first_err
         for attempt in range(1, self.retries):
-            wait = self.backoff * (2 ** (attempt - 1))
+            wait = self.policy.backoff_s(attempt)
             _log.warning(
-                "device_put of %.1f MB failed (%s); retry %d/%d in %.0fs",
+                "device_put of %.1f MB failed (%s); retry %d/%d in %.1fs",
                 staged.nbytes / 2**20, err, attempt, self.retries - 1, wait)
+            note_attempt("transfer.wire", "retry", attempt, wait)
             time.sleep(wait)
             self.stats.bump(retries=1)
             m = _metrics()
@@ -293,13 +285,16 @@ class TransferEngine:
             try:
                 with _tracer().span("ship.retry", attempt=attempt,
                                     bytes=int(staged.nbytes)):
+                    _faults.fire("transfer.wire")
                     x = jax.device_put(staged, self.device)
                     x.block_until_ready()   # surface transport errors HERE
                 return x
             except Exception as e:  # noqa: BLE001 — classified below
                 if not _is_transient(e):
+                    note_attempt("transfer.wire", "fatal", attempt, 0.0)
                     raise
                 err = e
+        note_attempt("transfer.wire", "exhausted", self.retries, 0.0)
         raise err
 
     def _complete(self, item):
@@ -310,6 +305,7 @@ class TransferEngine:
         if staged is not None:   # None: already completed at issue time
             with _tracer().span("ship.wire", bytes=int(staged.nbytes)):
                 try:
+                    _faults.fire("transfer.wire")
                     x.block_until_ready()
                 except Exception as e:  # noqa: BLE001 — classified below
                     if not _is_transient(e):

@@ -189,6 +189,35 @@ def test_broad_except_outside_retry_loop_clean():
     assert fs == []
 
 
+def test_policy_retry_loop_is_blessed_idiom():
+    # the RT002 message now points at resilience/policy.RetryPolicy.run —
+    # its own loop shape (classify → fatal raise, exhausted raise,
+    # deadline raise, else sleep) must itself lint clean, or the blessed
+    # idiom would flag itself
+    fs = lint("""
+        import time
+
+        def run(fn, classify, attempts, backoff_s):
+            err = None
+            for attempt in range(1, attempts + 1):
+                try:
+                    return fn()
+                except Exception as e:
+                    if not classify(e):
+                        raise
+                    err = e
+                    if attempt >= attempts:
+                        raise
+                    time.sleep(backoff_s(attempt))
+    """)
+    assert fs == []
+
+
+def test_rt002_message_names_policy_module():
+    fs = lint(RT002_POSITIVE)
+    assert "resilience/policy.RetryPolicy.run" in fs[0].message
+
+
 # ---------------------------------------------------------------------------
 # RT003 host-sync-in-trace
 

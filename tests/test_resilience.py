@@ -1,0 +1,558 @@
+"""Resilience plane: failpoints, retry policy, breakers, degraded
+serving (ISSUE 16).
+
+Covers the four pieces end to end: the `RTPU_FAULTS` grammar and its
+deterministic (seeded) injection replay; the unified RetryPolicy
+(classification, capped full-jitter backoff, deadline budgets); the
+per-peer circuit breakers with injected clocks; and the jobs-layer
+degraded-serving contract (`degraded: true` + covered watermark)
+through both the unit loop and the REST surface.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from raphtory_tpu.resilience import faults
+from raphtory_tpu.resilience.breaker import BREAKERS, CircuitBreaker
+from raphtory_tpu.resilience.degrade import DEGRADED, DegradedLedger
+from raphtory_tpu.resilience.policy import (RetryPolicy, default_classify,
+                                            is_transient_message)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts and ends with the plane disarmed and the
+    process-wide ledgers empty — chaos must not leak across tests."""
+    faults.disarm()
+    BREAKERS.reset()
+    DEGRADED.reset()
+    yield
+    faults.disarm()
+    BREAKERS.reset()
+    DEGRADED.reset()
+
+
+# ---- grammar ----
+
+def test_arm_grammar_full():
+    snap = faults.arm("transfer.wire=error:0.5:3:42,peer.scrape=slow:1.0")
+    assert set(snap) == {"transfer.wire", "peer.scrape"}
+    fp = snap["transfer.wire"]
+    assert fp["mode"] == "error" and fp["prob"] == 0.5
+    assert fp["count"] == 3 and fp["seed"] == 42
+    assert snap["peer.scrape"]["count"] is None   # unlimited
+
+
+def test_arm_malformed_entries_warn_and_skip(caplog):
+    """An operator typo is data, not a crash: bad entries are skipped
+    with a warning, good ones still arm."""
+    with caplog.at_level("WARNING", logger="raphtory_tpu.resilience"):
+        snap = faults.arm("nonsense,unknown.site=error:1.0,"
+                          "transfer.wire=explode:1.0,"
+                          "peer.scrape=error:7.0,"
+                          "ingest.sink=error:1.0")
+    assert set(snap) == {"ingest.sink"}
+    assert sum("skipped" in r.message for r in caplog.records) >= 4
+
+
+def test_resil_kill_switch(monkeypatch):
+    monkeypatch.setenv("RTPU_RESIL", "0")
+    assert faults.arm("transfer.wire=error:1.0") == {}
+    faults.fire("transfer.wire")   # disarmed: no raise
+
+
+def test_disarmed_fire_is_free():
+    faults.disarm()
+    faults.fire("transfer.wire")   # no registry, no raise, no lookup
+
+
+# ---- deterministic injection ----
+
+def _injection_trace(spec, n=200):
+    faults.arm(spec)
+    hits = []
+    for i in range(n):
+        try:
+            faults.fire("device.dispatch")
+            hits.append(0)
+        except faults.FaultError:
+            hits.append(1)
+    faults.disarm()
+    return hits
+
+
+def test_injection_replays_exactly():
+    """Same spec (same seed) → bit-identical injection schedule; a
+    different seed → a different one. This is what makes a chaos run a
+    committed, replayable artifact instead of luck."""
+    a = _injection_trace("device.dispatch=error:0.3::7")
+    b = _injection_trace("device.dispatch=error:0.3::7")
+    c = _injection_trace("device.dispatch=error:0.3::8")
+    assert a == b
+    assert a != c
+    assert 20 < sum(a) < 100   # prob 0.3 over 200 passes
+
+
+def test_default_seed_is_stable_per_site():
+    """Omitting the seed still replays: it derives from the site name,
+    not from process entropy."""
+    a = _injection_trace("device.dispatch=error:0.5")
+    b = _injection_trace("device.dispatch=error:0.5")
+    assert a == b
+
+
+def test_count_budget_exhausts():
+    faults.arm("ingest.sink=error:1.0:2")
+    for _ in range(2):
+        with pytest.raises(faults.FaultError):
+            faults.fire("ingest.sink")
+    faults.fire("ingest.sink")   # budget spent: passes clean
+    snap = faults.faultz()["sites"]["ingest.sink"]
+    assert snap["injected"] == 2 and snap["exhausted"]
+
+
+def test_slow_mode_delays_not_raises(monkeypatch):
+    monkeypatch.setenv("RTPU_FAULT_SLOW_S", "0.05")
+    faults.arm("watermark.advance=slow:1.0:1")
+    t0 = time.monotonic()
+    faults.fire("watermark.advance")
+    assert time.monotonic() - t0 >= 0.04
+
+
+def test_faultz_document_shape():
+    faults.arm("transfer.wire=error:1.0:1")
+    doc = faults.faultz()
+    assert doc["enabled"] is True
+    assert "transfer.wire" in doc["sites"]
+    assert isinstance(doc["breakers"], dict)
+    assert doc["degraded"].get("total") == 0
+
+
+# ---- retry policy ----
+
+def test_backoff_capped_full_jitter():
+    """Every draw lands in [0, min(cap, base·2^(k-1))] — and the cap
+    actually binds deep attempts."""
+    p = RetryPolicy(attempts=8, base_s=1.0, cap_s=4.0,
+                    rng=random.Random(3))
+    for attempt in range(1, 9):
+        ceiling = min(4.0, 2.0 ** (attempt - 1))
+        for _ in range(50):
+            w = p.backoff_s(attempt)
+            assert 0.0 <= w <= ceiling
+    # deep attempts: the cap binds (un-capped would be >= 64)
+    assert max(p.backoff_s(8) for _ in range(100)) <= 4.0
+
+
+def test_backoff_no_lockstep():
+    """Two callers failing at the same instant must NOT sleep the same
+    schedule (the retry-stampede regression): full jitter decorrelates
+    them."""
+    a = RetryPolicy(attempts=5, base_s=1.0, rng=random.Random(1))
+    b = RetryPolicy(attempts=5, base_s=1.0, rng=random.Random(2))
+    wa = [a.backoff_s(k) for k in range(1, 6)]
+    wb = [b.backoff_s(k) for k in range(1, 6)]
+    assert wa != wb
+    assert len(set(round(w, 6) for w in wa)) > 1   # not a constant either
+
+
+def test_run_retries_transient_then_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("Connection reset by peer")
+        return "ok"
+
+    p = RetryPolicy(attempts=4, base_s=0.0, rng=random.Random(0))
+    assert p.run(flaky, site="test") == "ok"
+    assert calls["n"] == 3
+
+
+def test_run_fatal_raises_immediately():
+    calls = {"n": 0}
+
+    def buggy():
+        calls["n"] += 1
+        raise ValueError("INVALID_ARGUMENT: bad shape")
+
+    p = RetryPolicy(attempts=4, base_s=0.0)
+    with pytest.raises(ValueError):
+        p.run(buggy, site="test")
+    assert calls["n"] == 1   # no backoff schedule burned on a bug
+
+
+def test_run_exhausts_attempts():
+    calls = {"n": 0}
+
+    def down():
+        calls["n"] += 1
+        raise TimeoutError("peer gone")
+
+    p = RetryPolicy(attempts=3, base_s=0.0)
+    with pytest.raises(TimeoutError):
+        p.run(down, site="test")
+    assert calls["n"] == 3
+
+
+def test_run_respects_deadline_budget():
+    """A backoff that would overrun the absolute deadline re-raises the
+    last transient error instead of sleeping through it — proved with an
+    injected clock, no real sleeping."""
+    now = [100.0]
+    slept = []
+
+    class _R:
+        def uniform(self, a, b):
+            return b   # worst-case draw: the full ceiling
+
+    def down():
+        raise TimeoutError("still down")
+
+    p = RetryPolicy(attempts=10, base_s=2.0, cap_s=2.0, rng=_R())
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        p.run(down, site="test", deadline=101.0, clock=lambda: now[0])
+    # first retry wants a 2 s sleep; 100 + 2 > 101 → refuse, re-raise
+    assert time.monotonic() - t0 < 1.0
+    assert not slept
+
+
+def test_classification_markers():
+    assert is_transient_message("UNAVAILABLE: flap") is True
+    assert is_transient_message("RESOURCE_EXHAUSTED: oom") is False
+    assert is_transient_message("who knows") is None
+    assert default_classify(faults.FaultError("UNAVAILABLE: x")) is True
+    assert default_classify(TimeoutError("x")) is True
+    assert default_classify(KeyError("x")) is False
+
+
+def test_retry_metric_counts():
+    from raphtory_tpu.obs.metrics import METRICS
+
+    def val(outcome):
+        return METRICS.registry.get_sample_value(
+            "raphtory_retry_attempts_total",
+            {"site": "unit", "outcome": outcome}) or 0.0
+
+    before = val("retry")
+    p = RetryPolicy(attempts=2, base_s=0.0)
+    with pytest.raises(TimeoutError):
+        p.run(lambda: (_ for _ in ()).throw(TimeoutError("x")),
+              site="unit")
+    assert val("retry") - before == 1.0
+
+
+# ---- circuit breakers ----
+
+def test_breaker_closed_open_halfopen_cycle():
+    now = [0.0]
+    br = CircuitBreaker("peer-a", threshold=3, window_s=10.0,
+                        clock=lambda: now[0])
+    assert br.state() == "closed"
+    for _ in range(3):
+        assert br.allow()
+        br.record(False, error="timeout")
+    assert br.state() == "open"
+    assert not br.allow()            # inside the window: gated
+    now[0] = 9.9
+    assert not br.allow()
+    now[0] = 10.1                    # window over: ONE probe allowed
+    assert br.allow()
+    assert br.state() == "half-open"
+    assert not br.allow()            # second caller in the same window
+    br.record(True)                  # probe succeeded
+    assert br.state() == "closed"
+    assert br.allow()
+
+
+def test_breaker_failed_probe_reopens_full_window():
+    now = [0.0]
+    br = CircuitBreaker("peer-b", threshold=1, window_s=5.0,
+                        clock=lambda: now[0])
+    br.record(False, error="down")
+    assert br.state() == "open"
+    now[0] = 5.5
+    assert br.allow()                # half-open probe
+    br.record(False, error="still down")
+    assert br.state() == "open"
+    now[0] = 10.0                    # 4.5 s into the RE-armed window
+    assert not br.allow()
+    now[0] = 10.6
+    assert br.allow()
+
+
+def test_breaker_snapshot_evidence():
+    now = [0.0]
+    br = CircuitBreaker("peer-c", threshold=1, window_s=8.0,
+                        clock=lambda: now[0])
+    br.record(True)
+    now[0] = 3.0
+    br.record(False, error="ConnectionRefused: nope")
+    snap = br.snapshot()
+    assert snap["state"] == "open"
+    assert snap["retry_in_s"] == pytest.approx(8.0)
+    assert snap["seconds_since_last_ok"] == pytest.approx(3.0)
+    assert "nope" in snap["last_error"]
+
+
+def test_breaker_registry_bounded():
+    BREAKERS.reset()
+    for i in range(300):
+        BREAKERS.get(f"http://peer-{i}")
+    assert len(BREAKERS.snapshot()) <= 256
+    # oldest evicted, newest kept
+    assert "http://peer-299" in BREAKERS.snapshot()
+    assert "http://peer-0" not in BREAKERS.snapshot()
+
+
+def test_breaker_state_gauge():
+    from raphtory_tpu.obs.metrics import METRICS
+
+    br = BREAKERS.get("gauge-peer", threshold=1, window_s=60.0)
+    br.record(False, error="x")
+    assert METRICS.registry.get_sample_value(
+        "raphtory_breaker_state", {"peer": "gauge-peer"}) == 2.0
+
+
+# ---- peer scraper breaker gating ----
+
+def test_clusterz_open_breaker_skips_dead_peer_without_timeout():
+    """Once a dead peer opens its breaker, a scrape pass renders the
+    breaker as the row's evidence and pays NO socket timeout."""
+    from raphtory_tpu.obs.cluster import PeerScraper
+
+    url = "http://127.0.0.1:9"   # discard port: connection refused
+    s = PeerScraper(timeout_s=0.3, ttl_s=0.0)
+    br = BREAKERS.get(url, threshold=2, window_s=60.0)
+    for _ in range(2):           # two real failures open the breaker
+        s.scrape([url])
+    assert br.state() == "open"
+    t0 = time.monotonic()
+    out = s.scrape([url])
+    assert time.monotonic() - t0 < 0.25   # no wire attempt paid
+    row = out[url]
+    assert row["reachable"] is False and row["down"] is True
+    assert row["breaker"]["state"] == "open"
+    assert "no timeout paid" in row["error"]
+
+
+# ---- degraded ledger ----
+
+def test_degraded_ledger_window_and_snapshot():
+    now = [1000.0]
+    led = DegradedLedger(clock=lambda: now[0])
+    led.note("job-1", "deadline", covered_time=42)
+    now[0] = 1100.0
+    led.note("job-2", "retry_budget")
+    assert led.total() == 2
+    assert led.recent(60.0) == 1      # only job-2 inside the window
+    snap = led.snapshot()
+    assert snap["total"] == 2
+    assert snap["last"][-1]["job_id"] == "job-2"
+
+
+def test_degraded_ledger_bounded():
+    led = DegradedLedger()
+    for i in range(500):
+        led.note(f"j{i}", "deadline")
+    assert led.total() == 500
+    assert led.recent(3600.0) <= 256   # the ring is the bound
+
+
+# ---- jobs-layer degraded serving (unit loop) ----
+
+def _range_job(**kw):
+    from raphtory_tpu.core.events import EventLog
+    from raphtory_tpu.core.service import TemporalGraph
+    from raphtory_tpu.algorithms import DegreeBasic
+    from raphtory_tpu.jobs.manager import Job, RangeQuery
+
+    log = EventLog()
+    log.append_batch([1, 2, 3], [0, 0, 0], [0, 1, 2], [1, 2, 0])
+    g = TemporalGraph(log)
+    q = RangeQuery(start=0, end=20, jump=10)
+    return Job("deg-test", DegreeBasic(), q, g, **kw), q
+
+
+def test_mid_sweep_deadline_serves_partial_marked_degraded():
+    job, q = _range_job(deadline_ms=60_000)
+    job.deadline = time.monotonic() - 1.0   # expires AFTER hop 1 starts
+    emitted = []
+    job._emit_mesh = lambda *p: emitted.append(p[0])
+
+    job._range_amortised(q, advance=lambda t: None,
+                         run=lambda w: (None, 0), freeze_rv=lambda: None)
+    assert emitted == [0]                 # hop 1 shipped, hops 2–3 cut
+    assert job.degraded and job.degraded_reason == "deadline"
+    assert job.covered_time == 0
+    assert DEGRADED.total() == 1
+
+
+def test_mid_sweep_transient_failure_degrades_not_fails():
+    job, q = _range_job()
+    emitted = []
+    job._emit_mesh = lambda *p: emitted.append(p[0])
+
+    def run(windows, _t=[0]):
+        _t[0] += 1
+        if _t[0] == 2:                    # hop 2 exhausts its budget
+            raise faults.FaultError("UNAVAILABLE: injected")
+        return None, 0
+
+    job._range_amortised(q, advance=lambda t: None, run=run,
+                         freeze_rv=lambda: None)
+    assert emitted == [0]
+    assert job.degraded and job.degraded_reason == "retry_budget"
+
+
+def test_mid_sweep_programming_error_still_fails():
+    job, q = _range_job()
+    job._emit_mesh = lambda *p: None
+
+    def run(windows, _t=[0]):
+        _t[0] += 1
+        if _t[0] == 2:
+            raise ValueError("INVALID_ARGUMENT: bad shape")
+        return None, 0
+
+    with pytest.raises(ValueError):
+        job._range_amortised(q, advance=lambda t: None, run=run,
+                             freeze_rv=lambda: None)
+    assert not job.degraded               # a wrong answer is not degraded
+
+
+def test_first_hop_transient_failure_still_fails():
+    """Nothing covered yet → nothing honest to degrade to."""
+    job, q = _range_job()
+
+    def run(windows):
+        raise faults.FaultError("UNAVAILABLE: injected")
+
+    with pytest.raises(faults.FaultError):
+        job._range_amortised(q, advance=lambda t: None, run=run,
+                             freeze_rv=lambda: None)
+    assert not job.degraded
+
+
+def test_healthz_grades_degraded_window():
+    from raphtory_tpu.obs.budget import healthz
+
+    code, payload = healthz()
+    assert "degraded_results_recent" not in payload
+    DEGRADED.note("j1", "deadline", covered_time=10)
+    code, payload = healthz()
+    assert code == 200
+    assert payload["degraded_results_recent"] == 1
+    assert payload["status"] in ("degraded", "burning")
+
+
+# ---- REST surface ----
+
+@pytest.fixture
+def rest_node():
+    from raphtory_tpu.core.service import TemporalGraph
+    from raphtory_tpu.ingestion.pipeline import IngestionPipeline
+    from raphtory_tpu.ingestion.source import IterableSource
+    from raphtory_tpu.ingestion.updates import EdgeAdd
+    from raphtory_tpu.jobs.manager import AnalysisManager
+    from raphtory_tpu.jobs.rest import RestServer
+
+    pipe = IngestionPipeline()
+    pipe.add_source(IterableSource(
+        [EdgeAdd(t, t % 8, (t + 1) % 8) for t in range(60)], name="t"))
+    pipe.run()
+    g = TemporalGraph(pipe.log, pipe.watermarks)
+    mgr = AnalysisManager(g)
+    srv = RestServer(mgr, port=0).start()
+    try:
+        yield g, mgr, srv
+    finally:
+        srv.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def test_faultz_endpoint(rest_node):
+    g, mgr, srv = rest_node
+    faults.arm("rest.handler=error:0.0")   # armed, never injects
+    doc = _get(srv.port, "/faultz")
+    assert doc["enabled"] is True
+    assert "rest.handler" in doc["sites"]
+    st = _get(srv.port, "/statusz")
+    assert st["resilience"]["faults_enabled"] is True
+    assert st["resilience"]["armed_sites"] == ["rest.handler"]
+
+
+def test_rest_injected_fault_is_classified_503(rest_node):
+    g, mgr, srv = rest_node
+    faults.arm("rest.handler=error:1.0:1")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(srv.port, "/Jobs")
+    assert ei.value.code == 503
+    assert ei.value.headers["Retry-After"] == "1"
+    body = json.loads(ei.value.read().decode())
+    assert body["injected"] is True
+    assert body["evidence"]["site"] == "rest.handler"
+    faults.disarm()
+    _get(srv.port, "/Jobs")               # budget spent: serves again
+
+
+def test_rest_half_open_client_frees_its_thread(rest_node, monkeypatch):
+    """A client that connects and never sends a request used to pin a
+    handler thread forever; the per-connection socket timeout reclaims
+    it and the server keeps serving."""
+    from raphtory_tpu.jobs.manager import AnalysisManager
+    from raphtory_tpu.jobs.rest import RestServer
+
+    g, mgr, srv0 = rest_node
+    monkeypatch.setenv("RTPU_REST_CONN_TIMEOUT_S", "0.5")
+    srv = RestServer(AnalysisManager(g), port=0).start()
+    try:
+        stalled = socket.create_connection(("127.0.0.1", srv.port),
+                                           timeout=5)
+        try:
+            stalled.sendall(b"GET /Jobs")   # half a request, then silence
+            time.sleep(0.8)                 # past the conn timeout
+            # the server must still answer OTHER clients promptly
+            t0 = time.monotonic()
+            assert isinstance(_get(srv.port, "/Jobs"), dict)
+            assert time.monotonic() - t0 < 2.0
+            # and the stalled connection was closed by the server
+            stalled.settimeout(2.0)
+            assert stalled.recv(1024) == b""
+        finally:
+            stalled.close()
+    finally:
+        srv.stop()
+
+
+def test_rest_results_carry_degraded_fields(rest_node):
+    from raphtory_tpu.algorithms import DegreeBasic
+    from raphtory_tpu.jobs.manager import RangeQuery
+
+    g, mgr, srv = rest_node
+    job = mgr.submit(DegreeBasic(), RangeQuery(start=0, end=50, jump=25))
+    jid = job.id
+    assert job.wait(30)
+    res = _get(srv.port, f"/AnalysisResults?jobID={jid}")
+    assert "degraded" not in res           # healthy runs: no noise
+    job.degraded = True
+    job.covered_time = 25
+    job.degraded_reason = "deadline"
+    res = _get(srv.port, f"/AnalysisResults?jobID={jid}")
+    assert res["degraded"] is True
+    assert res["coveredTime"] == 25
+    assert res["degradedReason"] == "deadline"

@@ -168,34 +168,34 @@ def test_device_sweep_pipelined_matches_serial():
             np.testing.assert_array_equal(w, np.asarray(g))
 
 
-def test_device_sweep_recovers_after_mid_sweep_failure(monkeypatch):
+def test_device_sweep_recovers_after_mid_sweep_failure():
     """A dispatch failure mid-pipelined-sweep leaves t_now ahead of the
     device buffers (the lookahead fold keeps moving) — the NEXT hop must
     take the full-refresh path and produce correct results, not scatter
-    deltas onto (or noop over) stale buffers."""
+    deltas onto (or noop over) stale buffers.
+
+    Driven through the ``device.dispatch`` failpoint (resilience/faults)
+    rather than a monkeypatch: the chaos the bench injects in production
+    code paths is the SAME failure this recovery test proves, so the two
+    can never drift apart."""
     from raphtory_tpu.algorithms import PageRank
     from raphtory_tpu.core.snapshot import build_view
     from raphtory_tpu.engine import bsp
     from raphtory_tpu.engine.device_sweep import DeviceSweep
+    from raphtory_tpu.resilience import faults
 
     rng = np.random.default_rng(9)
     log = random_log(rng, n_events=600, n_ids=40, t_span=80)
     pr = PageRank(max_steps=20, tol=1e-7)
     ds = DeviceSweep(log)
 
-    calls = {"n": 0}
-    real = ds._dispatch
-
-    def flaky(*a, **k):
-        calls["n"] += 1
-        if calls["n"] == 2:
-            raise RuntimeError("UNAVAILABLE: injected mid-sweep flap")
-        return real(*a, **k)
-
-    monkeypatch.setattr(ds, "_dispatch", flaky)
-    with pytest.raises(RuntimeError, match="mid-sweep flap"):
-        ds.run_sweep(pr, [10, 30, 50, 70], windows=[100], prefetch=True)
-    monkeypatch.setattr(ds, "_dispatch", real)
+    faults.arm("device.dispatch=error:1.0:1")
+    try:
+        with pytest.raises(faults.FaultError,
+                           match="injected fault at device.dispatch"):
+            ds.run_sweep(pr, [10, 30, 50, 70], windows=[100], prefetch=True)
+    finally:
+        faults.disarm()
 
     # continue the sweep: hop 50 (already folded by the lookahead) and a
     # fresh hop must both match the per-view reference exactly

@@ -1,0 +1,275 @@
+#!/usr/bin/env python3
+"""2-process kill/rejoin chaos smoke — the ISSUE-16 resilience proof.
+
+Driver (default mode) spawns TWO plain worker processes (no
+``jax.distributed`` — killing a member of a collectives bootstrap wedges
+the coordinator; the resilience surfaces under test here are REST
+federation + breakers, which only need ``RTPU_PROCESS_INDEX``), each
+serving REST on its own port with the other configured as a
+``RTPU_CLUSTER_PEERS`` peer. Then:
+
+* **healthy** — ``/clusterz`` on worker 0 shows BOTH members reachable;
+* **kill mid-sweep** — worker 1 is SIGKILLed while a long range sweep
+  is running on it (its ``/Jobs`` shows the running job first — the
+  artifact keeps the evidence);
+* **auto-down** — worker 0's scrape failures open the dead peer's
+  circuit breaker (``RTPU_BREAKER_THRESHOLD=2``): the ``/clusterz`` row
+  flips to ``down: true`` with the breaker snapshot as evidence and a
+  ``last_seen_seconds_ago`` staleness clock, and further passes pay NO
+  socket timeout;
+* **degraded serving** — the survivor answers a range request whose
+  committed fault schedule (``RTPU_FAULTS`` with an explicit seed — the
+  injection hop is deterministic) kills hop 3 of 3: the reply is
+  ``degraded: true`` with the covered-time watermark, ``/healthz``
+  grades ``degraded``, ``/faultz`` carries the injection count;
+* **rejoin** — worker 1 restarts on the same port; after the breaker
+  window (``RTPU_BREAKER_WINDOW_S=1``) one half-open probe succeeds,
+  the breaker closes, and ``/clusterz`` shows both members reachable
+  again.
+
+The phase snapshots are written to ``--out`` (the CI failure artifact).
+Exit 0 prints CHAOS_OK; any assertion prints the evidence and exits 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the committed schedule worker 0 serves the degraded query under:
+#: prob 0.5 seeded 0 → passes 1,2 clean, pass 3 injects (count budget 1,
+#: so exactly ONE hop dies, deterministically — replay is exact)
+_FAULT_SPEC = "device.dispatch=error:0.5:1:0"
+
+
+def _http_json(url, body=None, timeout=30.0):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _wait_http(url, timeout_s=90.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            return _http_json(url, timeout=5.0)
+        except OSError:   # refused/timeout: server still coming up
+            time.sleep(0.25)
+    raise TimeoutError(f"no answer from {url} within {timeout_s}s")
+
+
+def _wait_for(pred, what, timeout_s=30.0, pause=0.3):
+    """Poll ``pred()`` until truthy; returns its value. The predicate
+    swallows nothing — transport errors mean the survivor died, which
+    IS a smoke failure."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(pause)
+    raise TimeoutError(f"{what} not observed within {timeout_s}s")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ----------------------------------------------------------------- worker
+
+def worker(idx: int, port: int) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from raphtory_tpu.core.service import TemporalGraph
+    from raphtory_tpu.ingestion.pipeline import IngestionPipeline
+    from raphtory_tpu.ingestion.source import IterableSource
+    from raphtory_tpu.ingestion.updates import EdgeAdd
+    from raphtory_tpu.jobs.manager import AnalysisManager, RangeQuery
+    from raphtory_tpu.jobs import registry
+    from raphtory_tpu.jobs.rest import RestServer
+
+    pipe = IngestionPipeline()
+    pipe.add_source(IterableSource(
+        [EdgeAdd(t, t % 8, (t + 1) % 8) for t in range(301)],
+        name=f"chaos-{idx}"))
+    pipe.run()
+    graph = TemporalGraph(pipe.log, pipe.watermarks)
+    mgr = AnalysisManager(graph)
+    RestServer(mgr, port=port).start()
+    if idx == 1:
+        # the sweep the driver kills this process in the middle of:
+        # 150 hops of DegreeBasic keeps the job running for seconds
+        mgr.submit(registry.resolve("DegreeBasic", {}),
+                   RangeQuery(0, 300, 2), job_id="long_sweep")
+    print(f"WORKER_UP {idx}", flush=True)
+    while True:   # serve until the driver kills us (that IS the test)
+        time.sleep(1.0)
+
+
+# ----------------------------------------------------------------- driver
+
+def _spawn(idx: int, ports: list[int], with_faults: bool):
+    env = dict(
+        os.environ,
+        PYTHONPATH=_REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+        RTPU_PROCESS_INDEX=str(idx),
+        RTPU_CLUSTER_PEERS=",".join(f"127.0.0.1:{p}" for p in ports),
+        RTPU_CLUSTERZ_TIMEOUT="0.5",
+        RTPU_PORT_STRIDE="0",   # explicit distinct ports, no offsets
+        RTPU_BREAKER_THRESHOLD="2",
+        RTPU_BREAKER_WINDOW_S="1",
+        RTPU_BATCH_WINDOW_MS="0",   # ranges must take the device sweep
+    )
+    if with_faults:
+        env["RTPU_FAULTS"] = _FAULT_SPEC
+    else:
+        env.pop("RTPU_FAULTS", None)
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--worker", str(idx), "--port", str(ports[idx])],
+        env=env, cwd=_REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+
+def _peer_row(cz: dict, url: str) -> dict | None:
+    """The dead peer's row: keyed by url while unreachable, by
+    process_N once merged reachable."""
+    return cz["processes"].get(url)
+
+
+def run_smoke(out: str | None, timeout_s: float) -> int:
+    ports = [_free_port(), _free_port()]
+    b0 = f"http://127.0.0.1:{ports[0]}"
+    b1 = f"http://127.0.0.1:{ports[1]}"
+    peer1_url = b1
+    art: dict = {"ports": ports, "fault_spec": _FAULT_SPEC, "phases": {}}
+    procs: list = [None, None]
+    try:
+        procs[0] = _spawn(0, ports, with_faults=True)
+        procs[1] = _spawn(1, ports, with_faults=False)
+        _wait_http(f"{b0}/statusz", timeout_s)
+        _wait_http(f"{b1}/statusz", timeout_s)
+
+        # ---- phase 1: healthy federation ----
+        cz = _wait_for(
+            lambda: (lambda c: c if c["processes_reachable"] == 2
+                     else None)(_http_json(f"{b0}/clusterz")),
+            "both members reachable on /clusterz", timeout_s)
+        art["phases"]["healthy"] = {
+            "processes_reachable": cz["processes_reachable"]}
+
+        # ---- phase 2: kill worker 1 MID-SWEEP ----
+        jobs1 = _wait_for(
+            lambda: (lambda j: j if j.get("long_sweep") == "running"
+                     else None)(_http_json(f"{b1}/Jobs")),
+            "worker 1 sweep running", timeout_s)
+        art["phases"]["kill"] = {"jobs_on_victim": jobs1}
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait(10)
+
+        # ---- phase 3: breaker auto-down, no timeout paid ----
+        def _down():
+            row = _peer_row(_http_json(f"{b0}/clusterz"), peer1_url)
+            if row and row.get("down") and \
+                    row.get("breaker", {}).get("state") == "open":
+                return row
+            return None
+
+        row = _wait_for(_down, "dead peer breaker open", timeout_s)
+        assert row["reachable"] is False, row
+        assert "no timeout paid" in row["error"], row
+        t0 = time.monotonic()
+        _http_json(f"{b0}/clusterz")   # gated pass: no 0.5s timeout
+        gated_s = time.monotonic() - t0
+        assert gated_s < 0.45, f"gated scrape paid a timeout: {gated_s}"
+        art["phases"]["auto_down"] = {
+            "row": row, "gated_scrape_seconds": round(gated_s, 3),
+            "last_seen_seconds_ago": row.get("last_seen_seconds_ago")}
+
+        # ---- phase 4: survivor serves DEGRADED under the committed
+        # schedule (hop 3 of 3 dies; hops 1–2 ship, covered watermark)
+        sub = _http_json(f"{b0}/RangeAnalysisRequest", body={
+            "analyserName": "DegreeBasic", "start": 0, "end": 200,
+            "jump": 100, "jobID": "degraded_proof", "batch": False})
+        res = _wait_for(
+            lambda: (lambda r: r if r["status"] in
+                     ("done", "failed", "killed") else None)(
+                _http_json(f"{b0}/AnalysisResults?jobID=degraded_proof")),
+            "degraded job terminal", timeout_s)
+        assert res["status"] == "done", res
+        assert res.get("degraded") is True, res
+        assert res.get("coveredTime") == 100, res
+        assert res.get("degradedReason") == "retry_budget", res
+        hz = _http_json(f"{b0}/healthz")
+        assert hz.get("degraded_results_recent", 0) >= 1, hz
+        assert hz["status"] in ("degraded", "burning"), hz
+        fz = _http_json(f"{b0}/faultz")
+        assert fz["sites"]["device.dispatch"]["injected"] == 1, fz
+        art["phases"]["degraded_serving"] = {
+            "submit": sub,
+            "result": {k: res[k] for k in
+                       ("status", "degraded", "coveredTime",
+                        "degradedReason")},
+            "healthz_status": hz["status"], "faultz_sites": fz["sites"]}
+
+        # ---- phase 5: rejoin — breaker half-open probe closes ----
+        procs[1] = _spawn(1, ports, with_faults=False)
+        _wait_http(f"{b1}/statusz", timeout_s)
+
+        def _rejoined():
+            c = _http_json(f"{b0}/clusterz")
+            if c["processes_reachable"] == 2:
+                return c
+            return None
+
+        cz = _wait_for(_rejoined, "worker 1 rejoined on /clusterz",
+                       timeout_s)
+        fz = _http_json(f"{b0}/faultz")
+        br = fz["breakers"].get(peer1_url, {})
+        assert br.get("state") == "closed", fz["breakers"]
+        art["phases"]["rejoin"] = {
+            "processes_reachable": cz["processes_reachable"],
+            "breaker": br}
+    finally:
+        for p in procs:
+            if p is not None and p.poll() is None:
+                p.kill()
+        if out:
+            with open(out, "w") as f:
+                json.dump(art, f, indent=1, sort_keys=True)
+    print("CHAOS_OK", flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--worker", type=int, default=None)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--timeout", type=float, default=60.0)
+    args = ap.parse_args(argv)
+    if args.worker is not None:
+        worker(args.worker, args.port)
+        return 0
+    return run_smoke(args.out, args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
