@@ -197,6 +197,12 @@ class EventLog:
         self.min_time: int = np.iinfo(np.int64).max
         self.max_time: int = np.iinfo(np.int64).min
         self._version = 0  # bumped per append; snapshot cache invalidation key
+        # bumped per compact_to only: `version` moves on both appends and
+        # compactions, so version alone cannot tell pure growth (a pinned
+        # prefix is still a prefix of the live log) from a history rewrite
+        # (it is not). Incremental re-pinning (SweepBuilder.repin) needs
+        # exactly that distinction.
+        self._compactions = 0
         self._frozen = False
 
     # -- single-event API (the reference's EntityStorage verbs,
@@ -274,6 +280,10 @@ class EventLog:
     def version(self) -> int:
         return self._version
 
+    @property
+    def compactions(self) -> int:
+        return self._compactions
+
     def column(self, name: str) -> np.ndarray:
         """Zero-copy view of a column. Stable under concurrent appends
         (appends only extend past ``n``; rows < n are immutable)."""
@@ -301,6 +311,7 @@ class EventLog:
             # bounds/version read under the same lock that appends hold, so
             # they describe exactly the pinned n rows
             min_t, max_t, ver = self.min_time, self.max_time, self._version
+            compactions = self._compactions
         out = EventLog.__new__(EventLog)
         out._lock = threading.Lock()
         out._frozen = True
@@ -309,6 +320,7 @@ class EventLog:
         out.min_time = min_t
         out.max_time = max_t
         out._version = ver
+        out._compactions = compactions
         return out
 
     def pin(self) -> "EventLog":
@@ -360,6 +372,7 @@ class EventLog:
             else:
                 self.min_time, self.max_time = tail_min, tail_max
             self._version += 1
+            self._compactions += 1
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"EventLog(n={self.n}, time=[{self.min_time},{self.max_time}])"

@@ -150,7 +150,22 @@ class TemporalGraph:
                 # moved — an earlier small-time acquire may have recorded
                 # the post-pin min and synced the version already
                 if int(time) >= self._post_pin_min:
-                    sweep = None   # stale for this time: re-pin below
+                    # post-pin events land at or before `time`: ADOPT the
+                    # appended suffix in place (DeviceSweep.repin) so the
+                    # next advance folds exactly the new rows — the
+                    # incremental live-serving path. Only a genuine
+                    # rebuild condition (compaction, new vertex/pair,
+                    # out-of-order arrival, dtype overflow) re-pins from
+                    # scratch.
+                    if sweep.repin(self.log) == "extended":
+                        # invariant restored: the sweep's (frozen) pin
+                        # captured (n, version) atomically and now covers
+                        # every scanned row
+                        self._resident_n = sweep.sw.log.n
+                        self._resident_version = sweep.sw.log.version
+                        self._post_pin_min = 2**62
+                    else:
+                        sweep = None   # stale for this time: re-pin below
             if sweep is None:
                 from ..engine.device_sweep import DeviceSweep
 

@@ -405,6 +405,86 @@ class SweepBuilder:
         sw.last_delta = None
         return sw
 
+    # ---- incremental re-pin (live epoch serving) ----
+
+    def repin(self, live_log) -> str:
+        """Adopt rows appended to the LIVE log since this builder's pin,
+        without refolding history. Returns:
+
+        * ``"noop"``     — nothing new; the pin already covers the log.
+        * ``"extended"`` — the suffix was adopted in place: fold state,
+          ``t_prev`` and the dense vertex/pair dictionaries all remain
+          valid, and the next ``_advance`` folds exactly the new rows.
+        * ``"rebuild"``  — the suffix cannot be adopted; the caller must
+          construct a fresh builder (and refold from scratch).
+
+        Extension is only sound when the pinned snapshot is still a
+        PREFIX of the live log and the frozen dictionaries still cover
+        it, so ``"rebuild"`` is returned when any of these hold:
+
+        * the log was compacted (history rewritten — the pin is no
+          longer a prefix; detected via ``EventLog.compactions``);
+        * the suffix mentions a vertex id outside ``uv`` (the dense
+          dictionary, and every per-row dense id derived from it, is
+          frozen at pin time);
+        * a preseeded builder sees a (src, dst) pair outside ``e_enc``
+          (the preseed invariant is "every pair the log ever mentions");
+        * a suffix event lands at or below ``t_prev`` — the watermark
+          contract says events at or below the served fence never
+          arrive late, so such a row means the fence was not honoured
+          and already-folded state is stale.
+        """
+        new = live_log.pin()
+        n_old = len(self._t)
+        if (getattr(new, "compactions", 0)
+                != getattr(self.log, "compactions", 0)):
+            # checked BEFORE the row-count fast path: a compaction can
+            # rewrite history to the SAME row count, and "same n" says
+            # nothing about row identity across a rewrite
+            return "rebuild"
+        if new.n == n_old:
+            return "noop"
+        if new.n < n_old or not self._ok:
+            return "rebuild"
+        t_new = new.column("time")[n_old:]
+        k_new = new.column("kind")[n_old:]
+        s_new = new.column("src")[n_old:]
+        d_new = new.column("dst")[n_old:]
+        if self.t_prev is not None and len(t_new) \
+                and int(t_new.min()) <= self.t_prev:
+            return "rebuild"
+        is_e = (k_new == EDGE_ADD) | (k_new == EDGE_DELETE)
+        d_real = d_new[is_e]
+        ids = np.concatenate([s_new, d_real])
+        pos = np.searchsorted(self.uv, ids)
+        pos_c = np.clip(pos, 0, max(len(self.uv) - 1, 0))
+        if not len(self.uv) or not bool((self.uv[pos_c] == ids).all()):
+            return "rebuild"   # new vertex id: dense dictionary is stale
+        sd_new = pos[: len(s_new)]
+        dd_new = np.zeros(len(d_new), np.int64)
+        dd_new[is_e] = pos[len(s_new):]
+        if self._preseeded and is_e.any():
+            enc = self._pack(sd_new[is_e], dd_new[is_e])
+            epos = np.clip(np.searchsorted(self.e_enc, enc), 0,
+                           max(len(self.e_enc) - 1, 0))
+            if not len(self.e_enc) \
+                    or not bool((self.e_enc[epos] == enc).all()):
+                return "rebuild"   # new pair: preseeded table is stale
+        # adopt: rebind the log-derived views; everything else is valid
+        self.log = new
+        self._t = new.column("time")
+        self._k = new.column("kind")
+        self._s = new.column("src")
+        self._d = new.column("dst")
+        if self._sd_all is not None:
+            self._sd_all = np.concatenate([self._sd_all, sd_new])
+            self._dd_all = np.concatenate([self._dd_all, dd_new])
+        self._t_sorted = bool(
+            self._t_sorted
+            and (not len(t_new) or bool((t_new[:-1] <= t_new[1:]).all()))
+            and (n_old == 0 or int(t_new[0]) >= int(self._t[n_old - 1])))
+        return "extended"
+
     # ---- the sweep ----
 
     def view_at(self, time: int) -> GraphView:

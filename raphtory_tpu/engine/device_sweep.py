@@ -373,6 +373,31 @@ class DeviceSweep:
         # time==t_now noop or a delta scatter onto stale buffers
         self._stale = False
 
+    # ---- incremental re-pin (live serving) ----
+
+    def repin(self, live_log) -> str:
+        """Adopt rows appended to ``live_log`` since this sweep's pin
+        (``SweepBuilder.repin``). On ``"extended"`` everything stays
+        valid — the dense spaces are unchanged, so the static device
+        tables, the fold-state buffers and ``t_now`` keep describing the
+        same coordinate space, and the next ``advance`` folds exactly
+        the appended suffix as one delta instead of a from-scratch
+        rebuild. Returns ``"noop"`` / ``"extended"`` / ``"rebuild"``;
+        after ``"rebuild"`` the sweep must be DISCARDED (its pin may
+        already be rebound past the decision point)."""
+        if self._stale:
+            return "rebuild"   # buffers behind the clock: re-pin fresh
+        n_old = len(self.sw._t)
+        status = self.sw.repin(live_log)
+        if status != "extended":
+            return status
+        t_new = self.sw._t[n_old:]
+        if self.tdtype == np.int32 and len(t_new) and not (
+                int(t_new.min()) > np.iinfo(np.int32).min // 2
+                and int(t_new.max()) < np.iinfo(np.int32).max // 2):
+            return "rebuild"   # suffix overflows the narrowed time dtype
+        return "extended"
+
     # ---- sweep driving ----
 
     def advance(self, time: int) -> None:

@@ -363,13 +363,17 @@ def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
             return out, steps, adv
         if kind == "cc":
             (max_steps,) = algo_args
+            l0 = jnp.tile(rest[0][-W:], (H, 1)).T if warm else None
             out, steps = _cc_columns(me, mv, e_src, e_dst, n_pad, max_steps,
-                                     tile_budget=tile_budget, pcpm=pc)
+                                     tile_budget=tile_budget, pcpm=pc,
+                                     l_init=l0)
             return out, steps, adv
         max_steps, directed = algo_args
         ew = 1.0
+        nxt = 1   # rest[0] is the seed mask; weights then warm follow
         if weighted:
-            _, w_base, dw_pos, dw_val = rest
+            w_base, dw_pos, dw_val = rest[nxt], rest[nxt + 1], rest[nxt + 2]
+            nxt += 3
             cur_w, cols = w_base, []
             for h in range(H):   # same unrolled rebuild as the masks
                 if h or h0:
@@ -378,9 +382,11 @@ def _compiled_delta(kind: str, n_pad: int, m_pad: int, H: int, W: int,
                     cur_w[:, None], (cur_w.shape[0], W)))
             ew = jnp.concatenate(cols, axis=1)   # [m_pad, C] hop-major
             adv = adv + (cur_w,)
+        d0 = jnp.tile(rest[nxt][-W:], (H, 1)).T if warm else None
         out, steps = _bfs_columns(me, mv, e_src, e_dst, n_pad, max_steps,
                                   directed, rest[0], ew,  # rest[0]: seeds
-                                  tile_budget=tile_budget, pcpm=pc)
+                                  tile_budget=tile_budget, pcpm=pc,
+                                  d_init=d0)
         return out, steps, adv
 
     return _ledger.instrument(f"hopbatch.delta.{kind}", jax.jit(run),
@@ -530,7 +536,7 @@ def _edge_accumulate(seg, payload_of, combine, init, e_from, e_to, me, ew,
 
 
 def _cc_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
-                tile_budget: int | None = None, pcpm=None):
+                tile_budget: int | None = None, pcpm=None, l_init=None):
     """Columnar min-label propagation — connected components for every
     (hop, window) column at once (semantics of
     ``algorithms/connected_components.py``: undirected min over both
@@ -538,10 +544,22 @@ def _cc_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
     single-device kernel and the column-sharded mesh runner. ``pcpm``
     switches to the destination-binned operands (``_pagerank_columns``
     docstring); min reductions are order-exact, so binned results stay
-    BITWISE equal to the unbinned route."""
+    BITWISE equal to the unbinned route.
+
+    ``l_init`` ([n_pad, C] i32) warm-starts the propagation from a
+    previous epoch's labels: the start is ``min(own index, l_init)``.
+    The fixed point of min-label propagation is the min over each
+    component of the START values, so the warm result equals the cold
+    one iff every warm label is an index of a vertex in the same
+    component — true when the graph only GAINED edges/vertices since the
+    labels were computed (components only merge; a vertex's old label
+    indexes a vertex of its old component ⊆ its new component). Callers
+    enforce that monotonicity gate (``jobs/live.py``)."""
     I32_MAX = jnp.iinfo(jnp.int32).max
     lab0 = jnp.where(mv, jnp.arange(n_pad, dtype=jnp.int32)[:, None],
                      I32_MAX)
+    if l_init is not None:
+        lab0 = jnp.where(mv, jnp.minimum(lab0, l_init), I32_MAX)
     tile = _edge_tile_for(e_src.shape[0], me.shape[1], tile_budget)
     max0 = jnp.full_like(lab0, I32_MAX) \
         + (mv[0] & False).astype(jnp.int32)[None, :]   # vma-seeded
@@ -601,15 +619,26 @@ def _compiled_cc(n_pad: int, m_pad: int, H: int, C: int, max_steps: int,
 
 def _bfs_columns(me, mv, e_src, e_dst, n_pad: int, max_steps: int,
                  directed: bool, seed_mask, ew,
-                 tile_budget: int | None = None, pcpm=None):
+                 tile_budget: int | None = None, pcpm=None, d_init=None):
     """Columnar min-plus traversal (``algorithms/traversal.SSSP``
     semantics); ``ew`` is 1.0 for hop counting or [m_pad, C] f32 weights
     (BINNED when ``pcpm`` is set, like ``me``/``e_src``/``e_dst`` — see
     ``_pagerank_columns``). Min-plus is order-exact, so binned results
     stay bitwise equal. Shared by the single-device kernel and the
-    column-sharded runner."""
+    column-sharded runner.
+
+    ``d_init`` ([n_pad, C] f32) warm-starts the relaxation with
+    ``min(cold seed, d_init)``: valid whenever every finite ``d_init``
+    entry is a REALIZABLE path length in the current graph — true when
+    edges/vertices were only ADDED (at unit/unchanged weight) since the
+    distances were computed, so old shortest paths still exist and
+    relaxation can only tighten them. Callers enforce the gate
+    (``jobs/live.py``); weighted SSSP never warm-starts (a re-add can
+    RAISE a pair's weight, leaving stale under-estimates)."""
     INF = jnp.float32(jnp.inf)
     d0 = jnp.where(mv & seed_mask[:, None], 0.0, INF)
+    if d_init is not None:
+        d0 = jnp.where(mv, jnp.minimum(d0, d_init), INF)
     tile = _edge_tile_for(e_src.shape[0], me.shape[1], tile_budget)
     ew_arr = None if not hasattr(ew, "shape") or ew.ndim == 0 else ew
     inf0 = jnp.full_like(d0, INF) \
@@ -814,6 +843,10 @@ class _HopBatched:
         # the run's resolved partition layout (ops/partition.py), fixed
         # for the whole run at its start — None on the unbinned route
         self._active_layout = None
+        # cross-epoch warm seed (run(..., warm_state=...)): initialises
+        # the FIRST dispatch's iteration from a previous run's output —
+        # the live epoch engine's warm-start channel (jobs/live.py)
+        self._epoch_seed = None
 
     @property
     def _e_src(self):
@@ -870,6 +903,29 @@ class _HopBatched:
                                    _obs_device.nbytes_tree(adv))
         return out, steps
 
+    def repin(self) -> str:
+        """Adopt rows appended to the live log since this engine's pin
+        (``SweepBuilder.repin``): on ``"extended"`` every piece of engine
+        state stays valid — the dense dictionaries and pair table are
+        unchanged, so ``GlobalTables``, the cached device edge tables,
+        the host delta base AND the device-resident advanced base all
+        keep describing the same coordinate space, and the next ``run``
+        folds exactly the appended suffix. Returns ``"noop"`` /
+        ``"extended"`` / ``"rebuild"``; after ``"rebuild"`` the engine
+        must be DISCARDED and rebuilt over the live log (its pin may
+        already be rebound past the decision point)."""
+        n_old = len(self.sw._t)
+        status = self.sw.repin(self._log)
+        if status != "extended":
+            return status
+        t_new = self.sw._t[n_old:]
+        tdt = np.dtype(self.tables.tdtype)
+        if tdt == np.int32 and len(t_new) and not (
+                int(t_new.min()) > np.iinfo(np.int32).min // 2
+                and int(t_new.max()) < np.iinfo(np.int32).max // 2):
+            return "rebuild"   # suffix overflows the narrowed time dtype
+        return "extended"
+
     def _sync_layout(self):
         """Resolve the partition layout ONCE per run (``RTPU_PCPM`` /
         ``RTPU_PARTITIONS`` are dispatch-time knobs), and drop the
@@ -895,6 +951,12 @@ class _HopBatched:
     #: rebuild, ``_masks_from_deltas``; SSSP additionally rebuilds its
     #: weight state from base + per-hop deltas)
     supports_delta_fold = False
+
+    #: subclasses whose DELTA kernel accepts a cross-epoch warm seed
+    #: (``run(..., warm_state=...)``) under the caller-enforced monotone
+    #: gate — CC/BFS min-merge warm init. Contraction engines
+    #: (``supports_warm_start``) accept the seed on every path instead.
+    supports_epoch_warm = False
 
     #: set False by subclasses whose fold threads extra SEQUENTIAL state
     #: through the engine (SSSP's weight cursor) — they keep the serial
@@ -931,12 +993,21 @@ class _HopBatched:
         raise NotImplementedError
 
     def run(self, hop_times, windows, chunks: int = 1,
-            warm_start: bool = False, hop_callback=None):
+            warm_start: bool = False, hop_callback=None, warm_state=None):
         """``chunks=k`` pipelines the sweep; ``warm_start=True``
         additionally initialises each chunk's columns from the previous
         chunk's LAST-hop ranks (same fixed point, reached in far fewer
         steps when consecutive hops differ little). Warm-started results
         agree with cold ones to the solver tolerance, not bitwise.
+
+        ``warm_state`` (a previous ``run``'s output, ``[C_prev, n_pad]``
+        with the SAME window count) seeds the FIRST dispatch the same
+        way — the cross-epoch warm channel of the live epoch engine.
+        Contraction engines (PageRank) accept it unconditionally; for
+        CC/BFS the min-merge warm init is only equivalent under the
+        monotone (add-only, unwindowed) gate the CALLER must enforce
+        (``jobs/live.py``; kernel docstrings state the argument), and it
+        is ignored on the host-column path, which has no warm plumbing.
 
         With ``RTPU_FOLD_WORKERS`` > 1 the chunk folds run CONCURRENTLY
         on forked builders (bit-identical payloads — docs/FOLD.md), and
@@ -956,6 +1027,11 @@ class _HopBatched:
                 f"{type(self).__name__} cannot warm-start: its superstep "
                 "is not a contraction (stale state would be wrong, not "
                 "just slower)")
+        self._epoch_seed = None
+        if warm_state is not None and (
+                self.supports_warm_start
+                or (self.supports_epoch_warm and self._use_delta_fold())):
+            self._epoch_seed = warm_state
         hop_times = [int(x) for x in hop_times]
         chunks = max(1, min(int(chunks), len(hop_times)))
         from ..utils.transfer import shared_engine
@@ -1074,6 +1150,11 @@ class _HopBatched:
             # group IN-PROGRAM — no extra host-issued device ops
             # between dispatches (each is a tunnel round-trip)
             r_init = outs[-1]                              # [per*W, n_pad]
+        elif not outs and self._epoch_seed is not None:
+            # first dispatch of an epoch run: seed from the PREVIOUS
+            # run's output (same tail-slice-and-tile contract as the
+            # intra-run warm chunks; jobs/live.py owns the validity gate)
+            r_init = self._epoch_seed
         if delta:
             out, st = self._dispatch_deltas(payload, group, windows,
                                             r_init=r_init)  # async
@@ -1701,6 +1782,7 @@ class HopBatchedBFS(_HopBatched):
     are f32 with inf for unreached (SSSP-with-unit-weights semantics)."""
 
     supports_delta_fold = True
+    supports_epoch_warm = True   # min-merge seed (gate: _bfs_columns)
 
     def __init__(self, log: EventLog, seeds, directed: bool = False,
                  max_steps: int = 100):
@@ -1734,14 +1816,15 @@ class HopBatchedBFS(_HopBatched):
             layout=self._active_layout)
 
     def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
-        assert r_init is None   # guarded by supports_warm_start
+        # r_init is the cross-epoch warm seed (min-merged distances);
+        # validity is gated by the caller (_bfs_columns docstring)
         base, deltas_e, deltas_v = payload
         base, h0 = self._delta_base_args(base)
         return self._run_delta(lambda: run_columns_delta(
             "bfs", self.tables, base, deltas_e, deltas_v,
             hop_times, windows,
             algo_args=(int(self.max_steps), bool(self.directed)),
-            seed_mask=self._seed,
+            seed_mask=self._seed, r_init=r_init,
             e_src_dev=self._e_src, e_dst_dev=self._e_dst, h0_delta=h0,
             ship_counter=self._count_ship, layout=self._active_layout))
 
@@ -1757,6 +1840,9 @@ class HopBatchedSSSP(HopBatchedBFS):
     (earliest-wins) are refused — the ascending fold is last-wins."""
 
     supports_delta_fold = True   # weights rebuild on device too
+    #: a weight update can RAISE a pair's weight — old distances become
+    #: stale under-estimates, so SSSP never takes a cross-epoch seed
+    supports_epoch_warm = False
 
     #: the weight fold advances a SEQUENTIAL cursor over the sorted
     #: update stream — chunk folds cannot fork it independently yet
@@ -1821,6 +1907,54 @@ class HopBatchedSSSP(HopBatchedBFS):
             self._w_val = np.empty(0, np.float32)
             self._w_pos = np.empty(0, np.int64)
         self._w_cursor = 0
+
+    def repin(self) -> str:
+        n_old = len(self.sw._t)
+        status = super().repin()
+        if status != "extended":
+            return status
+        # extend the sorted weight-update stream with the suffix's
+        # props. The consumed prefix [:_w_cursor] is immutable history
+        # (times ≤ t_prev); the unconsumed tail re-sorts against the new
+        # updates, whose times interleave past the cursor (both are >
+        # t_prev — SweepBuilder.repin's watermark guard). A STABLE sort
+        # by time alone reproduces the (time, event-row) lexsort order:
+        # each block is already in it, and every suffix event row is
+        # greater than every pinned one.
+        log = self.sw.log
+        if self.weight_prop not in log.props._key_ids:
+            return "extended"
+        kid = log.props._key_ids[self.weight_prop]
+        if log.props.is_immutable(kid):
+            return "rebuild"   # key turned earliest-wins: __init__ refuses
+        pe = log.props.column("event")
+        sel = ((pe >= n_old) & (log.props.column("key") == kid)
+               & (log.props.column("tag") == log.props.NUM_TAG))
+        ev = pe[sel]
+        if not len(ev):
+            return "extended"
+        from ..core.events import EDGE_ADD
+
+        kinds = log.column("kind")[ev]
+        val = log.props.column("num")[sel][kinds == EDGE_ADD]
+        ev = ev[kinds == EDGE_ADD]
+        if not len(ev):
+            return "extended"
+        val = np.where(np.isnan(val), 1.0, val).astype(np.float32)
+        tt = log.column("time")[ev]
+        order = np.lexsort((ev, tt))
+        enc = self.sw._pack(self.sw._dense(log.column("src")[ev]),
+                            self.sw._dense(log.column("dst")[ev]))
+        pos = self.tables.eng_pos(enc)
+        cur = self._w_cursor
+        t_cat = np.concatenate([self._w_t[cur:], tt[order]])
+        v_cat = np.concatenate([self._w_val[cur:], val[order]])
+        p_cat = np.concatenate([self._w_pos[cur:], pos[order]])
+        tail = np.argsort(t_cat, kind="stable")
+        self._w_t = np.concatenate([self._w_t[:cur], t_cat[tail]])
+        self._w_val = np.concatenate([self._w_val[:cur], v_cat[tail]])
+        self._w_pos = np.concatenate([self._w_pos[:cur], p_cat[tail]])
+        return "extended"
 
     def _weight_cols(self, hop_times):
         t = self.tables
@@ -1894,7 +2028,10 @@ class HopBatchedSSSP(HopBatchedBFS):
             weight_cols=wcols, layout=self._active_layout)
 
     def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
-        assert r_init is None   # guarded by supports_warm_start
+        # never warm-started: a weight update can RAISE a pair's weight,
+        # making old distances stale under-estimates (_bfs_columns
+        # docstring) — the live engine always iterates SSSP cold
+        assert r_init is None
         base, deltas_e, deltas_v, w_base, w_deltas = payload
         base, h0 = self._delta_base_args(base)
         if h0:
@@ -1913,18 +2050,21 @@ class HopBatchedCC(_HopBatched):
     labels decode via ``tables.uv[label]`` (min vid of the component)."""
 
     supports_delta_fold = True
+    supports_epoch_warm = True   # min-merge seed (gate: _cc_columns)
 
     def __init__(self, log: EventLog, max_steps: int = 100):
         super().__init__(log)
         self.max_steps = max_steps
 
     def _dispatch_deltas(self, payload, hop_times, windows, r_init=None):
-        assert r_init is None   # guarded by supports_warm_start
+        # r_init is the cross-epoch warm seed (min-merged labels);
+        # validity is gated by the caller (_cc_columns docstring)
         base, deltas_e, deltas_v = payload
         base, h0 = self._delta_base_args(base)
         return self._run_delta(lambda: run_columns_delta(
             "cc", self.tables, base, deltas_e, deltas_v,
             hop_times, windows, algo_args=(int(self.max_steps),),
+            r_init=r_init,
             e_src_dev=self._e_src, e_dst_dev=self._e_dst, h0_delta=h0,
             ship_counter=self._count_ship, layout=self._active_layout))
 

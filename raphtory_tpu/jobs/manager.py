@@ -342,6 +342,17 @@ class Job:
             # returning guarantees the telemetry has landed
 
     def _run_live(self, q: LiveQuery) -> None:
+        """The live loop is a thin pacer over the epoch engine
+        (jobs/live.LiveEpochState): each iteration computes the target
+        timestamp exactly as before, then lets the epoch engine decide
+        HOW to serve it — incremental delta fold over the standing
+        columnar engine, full re-sweep fallback, or (wall mode, nothing
+        moved) a skip. Emission, freshness and pricing all happen
+        inside ``epoch()``; the wall-mode wait adapts to the staleness
+        budget (``next_wait``)."""
+        from .live import LiveEpochState
+
+        live = LiveEpochState(self)
         runs = 0
         t_target = None
         while not self._kill.is_set():
@@ -367,18 +378,7 @@ class Job:
                 t = t_target
             else:
                 t = min(self.graph.safe_time(), self.graph.latest_time)
-            self._run_at(t, q, exact=False)
-            # freshness plane (obs/freshness.py): this run's result
-            # reflects the graph at t — record its staleness against
-            # the ingest head, keyed by this job's trace id so a
-            # /freshz staleness exemplar resolves at /tracez
-            try:
-                head = int(self.graph.latest_time)
-            except Exception:   # empty log has no latest time
-                head = None
-            _fresh.note_live_result(
-                self.ledger.algorithm or type(self.program).__name__,
-                int(t), head_time=head, trace_id=self.trace_id)
+            live.epoch(q, int(t))
             runs += 1
             if q.max_runs is not None and runs >= q.max_runs:
                 break
@@ -392,7 +392,7 @@ class Job:
                         and t_target >= self.graph.latest_time):
                     break
             else:
-                self._kill.wait(q.repeat)
+                self._kill.wait(live.next_wait(q))
 
     def _run_coalesced(self, q) -> bool:
         """Wait on this job's scheduler collect-window handle and, when

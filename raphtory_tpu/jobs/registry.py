@@ -41,7 +41,12 @@ def resolve(name: str, params: dict | None = None) -> VertexProgram:
     if cls is None:
         raise KeyError(
             f"unknown analyser {name!r}; registered: {known}")
-    return cls(**(params or {}))
+    # REST params arrive as JSON, so sequence hyperparams (e.g. SSSP
+    # seeds) come in as lists — programs must stay hashable (the
+    # compiled-runner cache keys on them), so freeze them here
+    params = {k: tuple(v) if isinstance(v, list) else v
+              for k, v in (params or {}).items()}
+    return cls(**params)
 
 
 def compile_source(source: str) -> VertexProgram:

@@ -665,12 +665,40 @@ class ServingScheduler:
     def price(self, program, query) -> float:
         """Estimated cost seconds of a request: its view count × the
         algorithm's EWMA seconds-per-view from completed-job history
-        (``DEFAULT_PRICE_S`` before any history exists)."""
+        (``DEFAULT_PRICE_S`` before any history exists). Live
+        subscriptions price from the ``live:`` book when the epoch
+        engine has fed it — an incremental epoch costs O(delta), not
+        the O(m) a one-shot view of the same algorithm implies, so the
+        admission book must not overcharge standing subscriptions."""
+        from .manager import LiveQuery
+
         alg = getattr(program, "cost_label", type(program).__name__)
         views = views_of(query)
         with self._cond:
             per = self._prices.get(alg, (DEFAULT_PRICE_S, 0))[0]
+            if isinstance(query, LiveQuery):
+                live = self._prices.get(f"live:{alg}")
+                if live is not None:
+                    per = live[0]
         return views * per
+
+    def note_live_epoch(self, algorithm: str, seconds: float) -> None:
+        """One live epoch served in ``seconds``: EWMA it into the
+        ``live:<algorithm>`` price-book key so admission prices standing
+        subscriptions from measured epoch cost rather than the one-shot
+        view price (same 0.7/0.3 fold as ``complete()``)."""
+        alg = f"live:{algorithm}"
+        per = max(0.0, float(seconds))
+        with self._cond:
+            _san_note(self._san_tracker, True)
+            prev = self._prices.get(alg)
+            if prev is None:
+                if len(self._prices) >= MAX_PRICE_KEYS:
+                    return   # bounded book (RT011)
+                self._prices[alg] = (per, 1)
+            else:
+                ewma, n = prev
+                self._prices[alg] = (0.7 * ewma + 0.3 * per, n + 1)
 
     def admit(self, program, query, tenant: str,
               deadline_ms=None) -> float:

@@ -74,6 +74,12 @@ DEFAULT_PENDING = 4096
 #: unbounded per-source/per-algorithm state through the ingest surface
 MAX_SOURCES = 256
 MAX_ALGOS = 64
+#: live-subscription table cap (RT011): one row per live job, keyed by
+#: job id — a runaway submitter must not mint unbounded registry state
+MAX_LIVE_SUBS = 64
+#: per-subscription ring of recent epochs (mode, delta rows, shipped
+#: bytes, staleness) — the /freshz epoch SERIES, bounded per sub
+MAX_LIVE_RECENT = 128
 #: head-clock ring: (event_time_head, wall) pairs, ~1 per sink batch
 HEAD_RING = 4096
 #: per-source batch-arrival ring for the updates/s window
@@ -242,6 +248,10 @@ class FreshnessRegistry:
         self._head: deque = deque(maxlen=HEAD_RING)
         self._staleness: dict[str, _Hist] = {}
         self.dropped_algos = 0
+        #: per-live-subscription epoch table (bounded, keyed by job id):
+        #: what /statusz + /freshz surface about the epoch engine
+        self._live_subs: dict = {}
+        self.dropped_live_subs = 0
         self.undated_results = 0
         self.last_safe: int | None = None
         self.last_safe_wall = 0.0
@@ -512,24 +522,26 @@ class FreshnessRegistry:
     def note_live_result(self, algorithm: str, result_time: int,
                          head_time: int | None = None,
                          trace_id: str | None = None,
-                         now: float | None = None) -> None:
+                         now: float | None = None) -> float | None:
         """One Live job run emitted a result computed at event time
         ``result_time``: record its staleness — how long ago the data it
         reflects stopped being the ingest head — into the per-algorithm
-        histogram. ``head_time`` (the caller's ``graph.latest_time``)
-        backs up the head clock for graphs ingested outside the
-        pipeline; a result we cannot date is counted, never guessed.
-        Never raises into the live-job loop."""
+        histogram, and return it (None when the result can't be dated)
+        so the epoch engine can feed its per-subscription table and
+        cadence without re-walking the head ring. ``head_time`` (the
+        caller's ``graph.latest_time``) backs up the head clock for
+        graphs ingested outside the pipeline; a result we cannot date is
+        counted, never guessed. Never raises into the live-job loop."""
         if not enabled():
-            return
+            return None
         try:
-            self._note_live_result(algorithm, result_time, head_time,
-                                   trace_id, now)
+            return self._note_live_result(algorithm, result_time,
+                                          head_time, trace_id, now)
         except Exception:   # telemetry never fails a live job
-            pass
+            return None
 
     def _note_live_result(self, algorithm, result_time, head_time,
-                          trace_id, now) -> None:
+                          trace_id, now) -> float | None:
         now = time.time() if now is None else float(now)
         result_time = int(result_time)
         staleness: float | None = None
@@ -538,7 +550,7 @@ class FreshnessRegistry:
             head = self._head[-1][0] if self._head else head_time
             if head is None:
                 self.undated_results += 1
-                return
+                return None
             if result_time >= int(head):
                 staleness = 0.0    # the result reflects the whole head
             else:
@@ -554,19 +566,96 @@ class FreshnessRegistry:
                     wall = w
                 if wall is None:   # ring empty (head_time backstop only)
                     self.undated_results += 1
-                    return
+                    return None
                 staleness = max(0.0, now - wall)
             alg = str(algorithm)
             h = self._staleness.get(alg)
             if h is None:
                 if len(self._staleness) >= MAX_ALGOS:
                     self.dropped_algos += 1
-                    return
+                    return staleness
                 h = self._staleness[alg] = _Hist(DEFAULT_SECONDS_BUCKETS)
             h.observe(staleness, trace_id, now)
         m = _metrics()
         if m is not None:
             m.freshness_staleness.labels(str(algorithm)).observe(staleness)
+        return staleness
+
+    def note_live_epoch(self, key: str, *, algorithm: str, mode: str,
+                        delta_rows: int = 0, ship_bytes: int = 0,
+                        staleness_s: float | None = None,
+                        result_time: int | None = None,
+                        now: float | None = None) -> None:
+        """One epoch of a live subscription was served: update the
+        bounded per-subscription table /statusz + /freshz surface.
+        ``key`` identifies the subscription (job id), ``mode`` is the
+        epoch mode (incremental|rebase|resweep|skipped|resync).
+        Never raises into the live-job loop."""
+        if not enabled():
+            return
+        try:
+            now = time.time() if now is None else float(now)
+            with self._lock:
+                _san_note(self._san_tracker, True)
+                row = self._live_subs.get(key)
+                if row is None:
+                    if len(self._live_subs) >= MAX_LIVE_SUBS:
+                        self.dropped_live_subs += 1
+                        return
+                    row = self._live_subs[key] = {
+                        "algorithm": str(algorithm), "epochs": 0,
+                        "incremental": 0, "fallback": 0,
+                        "modes": {},
+                        "last_delta_rows": 0, "last_ship_bytes": 0,
+                        "last_staleness_seconds": None,
+                        "last_result_time": None, "last_wall": 0.0,
+                        "recent": deque(maxlen=MAX_LIVE_RECENT),
+                    }
+                row["epochs"] += 1
+                row["modes"][str(mode)] = row["modes"].get(str(mode), 0) + 1
+                if mode in ("incremental", "resync"):
+                    row["incremental"] += 1
+                elif mode in ("resweep", "rebase"):
+                    row["fallback"] += 1
+                row["last_delta_rows"] = int(delta_rows)
+                row["last_ship_bytes"] = int(ship_bytes)
+                if staleness_s is not None:
+                    row["last_staleness_seconds"] = round(
+                        float(staleness_s), 4)
+                if result_time is not None:
+                    row["last_result_time"] = int(result_time)
+                row["last_wall"] = now
+                # bounded per-epoch ring: lets /freshz (and the
+                # live_stream bench's median-staleness / ship-bytes
+                # verification) see the epoch SERIES, not just the last
+                row["recent"].append({
+                    "mode": str(mode),
+                    "delta_rows": int(delta_rows),
+                    "ship_bytes": int(ship_bytes),
+                    "staleness_seconds": (None if staleness_s is None
+                                          else round(float(staleness_s),
+                                                     4)),
+                })
+        except Exception:   # telemetry never fails a live job
+            pass
+
+    def live_subscription_rows(self) -> dict:
+        """Snapshot of the per-subscription epoch table (exported on
+        /statusz + /freshz; jobs/manager embeds it in failure-artifact
+        dumps)."""
+        with self._lock:
+            return {k: dict(v, modes=dict(v["modes"]),
+                            recent=[dict(r) for r in v["recent"]])
+                    for k, v in self._live_subs.items()}
+
+    def live_grade(self, algorithm: str) -> str:
+        """Most recent staleness-budget grade for ``algorithm`` (as
+        written by ``budget_evaluate``; "ok" when the algorithm has no
+        target). The epoch engine's cadence reads this — a burning
+        budget shortens the inter-epoch wait to the floor."""
+        self.budget_evaluate()   # refresh (cached for EVAL_CACHE_S)
+        with self._lock:
+            return self._last_grades.get(str(algorithm), "ok")
 
     # ---- readers (series-ring collectors, surfaces) ----
 
@@ -765,6 +854,11 @@ class FreshnessRegistry:
             stale_p99 = {a: h.quantile(0.99)
                          for a, h in self._staleness.items()}
             last_safe = self.last_safe
+            # compact block: the per-epoch ``recent`` ring stays on
+            # /freshz (this block is federated via /clusterz)
+            live_subs = {k: {f: (dict(val) if f == "modes" else val)
+                             for f, val in v.items() if f != "recent"}
+                         for k, v in self._live_subs.items()}
         bud = self.budget_evaluate()
         return {
             "enabled": enabled(),
@@ -777,6 +871,7 @@ class FreshnessRegistry:
             "last_safe_time": last_safe,
             "staleness_p99_seconds": {a: round(v, 4)
                                       for a, v in stale_p99.items()},
+            "live_subscriptions": live_subs,
             "grade": bud["grade"],
         }
 
@@ -801,8 +896,12 @@ class FreshnessRegistry:
                       "dead_letter_events": self._route_pending}
             meta = {"dropped_sources": self.dropped_sources,
                     "dropped_algorithms": self.dropped_algos,
+                    "dropped_live_subscriptions": self.dropped_live_subs,
                     "undated_results": self.undated_results,
                     "last_safe_time": self.last_safe}
+            live_subs = {k: dict(v, modes=dict(v["modes"]),
+                                 recent=[dict(r) for r in v["recent"]])
+                         for k, v in self._live_subs.items()}
         return {
             "enabled": enabled(),
             "sources": sources,
@@ -814,6 +913,7 @@ class FreshnessRegistry:
             "staged_queues": self.staged_queues(),
             "queryable_lag_seconds": round(
                 self.queryable_lag_seconds(now), 3),
+            "live_subscriptions": live_subs,
             "budget": self.budget_evaluate(),
             **meta,
         }
@@ -852,6 +952,8 @@ class FreshnessRegistry:
             self._sources.clear()
             self._head.clear()
             self._staleness.clear()
+            self._live_subs.clear()
+            self.dropped_live_subs = 0
             self._routed.clear()
             self._route_pending = 0
             self._pipes = []
@@ -877,10 +979,11 @@ FRESH = FreshnessRegistry()
 
 
 def note_live_result(algorithm, result_time, head_time=None,
-                     trace_id=None, now=None) -> None:
+                     trace_id=None, now=None) -> float | None:
     """Module-level convenience for the jobs layer."""
-    FRESH.note_live_result(algorithm, result_time, head_time=head_time,
-                           trace_id=trace_id, now=now)
+    return FRESH.note_live_result(algorithm, result_time,
+                                  head_time=head_time,
+                                  trace_id=trace_id, now=now)
 
 
 def freshz() -> dict:

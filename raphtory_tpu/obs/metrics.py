@@ -132,6 +132,14 @@ class Metrics:
         self.supersteps = Counter(
             "raphtory_supersteps_total",
             "BSP supersteps executed on device", registry=r)
+        # live epoch engine (jobs/live.LiveEpochState): bounded labels —
+        # algorithm is capped by the freshness registry's MAX_ALGOS and
+        # mode is a closed five-value set
+        self.live_epochs = Counter(
+            "raphtory_live_epochs_total",
+            "Live-subscription epochs served, by algorithm and epoch "
+            "mode (incremental|rebase|resweep|skipped|resync)",
+            ["algorithm", "mode"], registry=r)
         # transfer pipeline (utils/transfer.TransferEngine) — the H2D link
         # is the term that bounds a real sweep on a tunnelled accelerator,
         # so the pipeline's stalls are first-class signals
