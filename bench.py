@@ -1986,6 +1986,139 @@ def bench_telemetry_overhead():
     }
 
 
+def bench_journal_overhead():
+    """Durable-journal overhead on the serving path — the ISSUE-18
+    proof row (acceptance: <= 5% median interleaved-pair overhead).
+
+    Both arms run with span tracing ON: the journal's writers ride the
+    tracer's record path and the ledger publication points, so the
+    honest marginal cost is journal-on vs journal-off UNDER the same
+    telemetry load, not journal+tracing vs nothing. The on-arm
+    continuously CRC-frames, batches and fsyncs every span / instant /
+    ledger record into a throwaway segment directory
+    (RTPU_JOURNAL_FLUSH_MS batching — obs/journal.py); the off-arm pays
+    exactly one environ lookup per hook (the zero-overhead-off
+    contract). Interleaved off/on pairs, judged on the MEDIAN per-pair
+    ratio (sequential A-then-B on a shared box reads drift as
+    overhead). RTPU_BENCH_CHEAP=1 shrinks the shape for CI
+    (`journal_overhead_cheap`, its own perfwatch series)."""
+    import shutil
+    import tempfile
+
+    from raphtory_tpu.algorithms import PageRank
+    from raphtory_tpu.core.service import TemporalGraph
+    from raphtory_tpu.jobs.manager import AnalysisManager, RangeQuery
+    from raphtory_tpu.obs import journal
+    from raphtory_tpu.obs.trace import TRACER
+    from raphtory_tpu.utils.synth import gab_like_log
+
+    cheap = os.environ.get("RTPU_BENCH_CHEAP", "0") not in ("", "0")
+    if cheap:
+        log = gab_like_log(n_vertices=8_000, n_edges=80_000,
+                           t_span=_GAB_SPAN)
+        n_hops, pairs = 8, 5
+    else:
+        log = _gab_log()
+        n_hops, pairs = 12, 3
+    view_times = np.linspace(0.45 * _GAB_SPAN, _GAB_SPAN,
+                             n_hops).astype(np.int64)
+    windows = [2_600_000, 604_800, 86_400]
+    q = RangeQuery(int(view_times[0]), int(view_times[-1]),
+                   int(view_times[1] - view_times[0]) or 1,
+                   windows=tuple(windows))
+    graph = TemporalGraph(log)
+    jdir = tempfile.mkdtemp(prefix="rtpu-bench-journal-")
+    was_enabled = TRACER.enabled
+    saved = {k: os.environ.get(k)
+             for k in ("RTPU_JOURNAL", "RTPU_JOURNAL_DIR")}
+
+    def arm(on: bool):
+        if on:
+            os.environ["RTPU_JOURNAL_DIR"] = jdir
+            os.environ["RTPU_JOURNAL"] = "1"
+        else:
+            os.environ["RTPU_JOURNAL"] = "0"
+            journal.shutdown()      # no writer thread in the off arm
+
+    def once():
+        mgr = AnalysisManager(graph)
+        t0 = _time.perf_counter()
+        job = mgr.submit(PageRank(tol=1e-7, max_steps=20), q)
+        ok = job.wait(600)
+        dt = _time.perf_counter() - t0
+        if not ok or job.status != "done":
+            raise RuntimeError(f"bench job {job.status}: {job.error}")
+        return dt
+
+    jstat = {}
+    try:
+        TRACER.enable()             # both arms pay tracing identically
+        arm(True)
+        once()          # warm: compiles + fold cache + segments, untimed
+        ab = []
+        for i in range(pairs):
+            # interleaved ABBA pairs (alternating arm order cancels
+            # monotone box drift), best-of-2 per arm (one GC or
+            # scheduler spike must not masquerade as journal cost)
+            order = (False, True) if i % 2 == 0 else (True, False)
+            t = {}
+            for on in order:
+                arm(on)
+                t[on] = min(once(), once())
+            ab.append((t[False], t[True]))
+        j = journal.get()
+        if j is not None:
+            j.flush(5.0)
+            jstat = j.status()
+    finally:
+        journal.shutdown()
+        TRACER.enabled = was_enabled
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(jdir, ignore_errors=True)
+
+    ratios = sorted(on / off for off, on in ab)
+    median = ratios[len(ratios) // 2] if len(ratios) % 2 \
+        else (ratios[len(ratios) // 2 - 1] + ratios[len(ratios) // 2]) / 2
+    off_min = min(off for off, _ in ab)
+    on_min = min(on for _, on in ab)
+    return {
+        "config": ("journal_overhead_cheap" if cheap
+                   else "journal_overhead"),
+        "metric": ("durable-journal overhead on the jobs path "
+                   "(CRC-framed fsync'd journal on vs off, tracing on "
+                   "in both arms, "
+                   + ("CI cheap shape)" if cheap
+                      else "GAB-scale windowed-PageRank range job)")),
+        "value": round((median - 1.0) * 100.0, 2),
+        "unit": "percent_slower_with_journal",
+        "detail": {
+            "n_views": n_hops * len(windows),
+            "engine": "jobs_manager_range (hopbatch columnar route)",
+            "cheap_mode": cheap,
+            "timing": ("interleaved_ABBA_pairs_median_ratio_best_of_2 — "
+                       "median of per-pair on/off ratios, alternating arm "
+                       "order, best-of-2 per arm; both arms trace and "
+                       "serve folds from the cross-request cache"),
+            "pairs": [[round(a, 4), round(b, 4)] for a, b in ab],
+            "per_pair_overhead_pct": [round((r - 1) * 100, 2)
+                                      for r in ratios],
+            "min_vs_min_overhead_pct": round(
+                (on_min / off_min - 1.0) * 100.0, 2),
+            "journal_off_seconds": round(off_min, 4),
+            "journal_on_seconds": round(on_min, 4),
+            "journal": {k: jstat.get(k) for k in
+                        ("records_written", "bytes_written", "drops",
+                         "rotations", "write_errors")},
+            "acceptance": "on/off regression must stay <= 5%",
+            "baseline": "the journal-off column of this same row",
+        },
+    }
+
+
 def bench_serving_storm():
     """Serving scheduler under a concurrent mixed request storm — the
     ISSUE-13 proof row (BENCH_r15).
@@ -3144,6 +3277,7 @@ CONFIGS = {
     "transfer_pipeline": bench_transfer_pipeline,
     "trace_overhead": bench_trace_overhead,
     "telemetry_overhead": bench_telemetry_overhead,
+    "journal_overhead": bench_journal_overhead,
     "serving_storm": bench_serving_storm,
     "chaos_storm": bench_chaos_storm,
     "advisor_overhead": bench_advisor_overhead,

@@ -88,10 +88,34 @@ def _is_row(obj) -> bool:
             and ("metric" in obj or "config" in obj))
 
 
+def journal_rows(directory: str) -> list[dict]:
+    """Bench-shaped rows derived from a telemetry-journal directory
+    (obs/journal.py segments): per-algorithm per-phase median seconds
+    from the journaled query ledgers, plus per-span-name duration
+    medians. A journal dir passed as trajectory or ``--head`` thereby
+    rides the same band machinery as a committed BENCH artifact — the
+    postmortem plane's evidence doubles as a perf series."""
+    from . import postmortem
+
+    profile = postmortem._run_profile(
+        postmortem.merge_records(postmortem.load_segments([directory])))
+    rows = []
+    for prefix, table in (("journal_phase", "phase_seconds"),
+                          ("journal_span", "span_seconds")):
+        for key, st in sorted(profile[table].items()):
+            rows.append({"config": f"{prefix}:{key}",
+                         "value": st["median"], "unit": "seconds",
+                         "detail": {"n": st["n"]}})
+    return rows
+
+
 def load_rows(path: str) -> list[dict]:
     """Bench rows from one artifact, across every format the repo has
     committed: ``{row}``, ``{rows}``, ``{parsed}``, a bare row, a list of
-    rows, or bench.py's raw JSONL stdout."""
+    rows, or bench.py's raw JSONL stdout. A DIRECTORY is read as a
+    telemetry-journal dir (``journal_rows``)."""
+    if os.path.isdir(path):
+        return journal_rows(path)
     with open(path) as f:
         text = f.read()
     try:

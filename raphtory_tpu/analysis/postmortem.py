@@ -1,0 +1,525 @@
+"""postmortem — replay durable telemetry journals into cluster answers.
+
+The journal (obs/journal.py) gets telemetry to disk before a process
+dies; this module is the other half: load one or many journal
+directories, merge every process's segments into a single time-ordered
+cluster timeline, and answer the questions an operator asks over a
+corpse — what was process 1 doing when it died, which queries were in
+flight, where did the time go, and what regressed between two runs.
+
+Stdlib-only, like the rest of ``raphtory_tpu.analysis`` —
+``tools/rtpu-postmortem`` loads it with zero runtime deps. The CRC
+framing is NOT re-implemented here: ``obs/journal.py`` (itself
+stdlib-only and standalone-importable) is loaded by file path, so the
+reader and the writer can never drift apart.
+
+Subcommands (``tools/rtpu-postmortem <cmd> --help``):
+
+* ``status DIR...`` — segment inventory per process: bytes, record and
+  kind counts, torn tails (the SIGKILL signature), sequence gaps (the
+  on-disk evidence of queue-overflow drops).
+* ``timeline DIR...`` — the merged cluster timeline, filterable by
+  ``--kind``, ``--trace``, ``--tenant``, ``--process``, ``--since`` /
+  ``--until`` (unix seconds); ``--format json`` for machines.
+* ``reconstruct DIR... --process N`` — a dead member's final story from
+  its journal alone: last record, its final trace's sweep timeline,
+  last live-epoch state per subscription, last query ledgers, the tail
+  of fault/breaker/degrade/sched events.
+* ``export DIR... --format chrome|collapsed`` — Chrome-trace JSON
+  (span timestamps re-based onto each record's wall clock, so processes
+  align on one axis) or collapsed stacks (self-time-weighted parent
+  chains) for flamegraph tooling.
+* ``diff A B`` — phase/kernel regression attribution between two runs:
+  per-algorithm per-phase medians from ledger records and per-span-name
+  duration medians, judged against ``--threshold``.
+
+Torn or corrupt segment tails are skipped and COUNTED, never fatal —
+a postmortem tool that crashes on the damage it exists to read would
+be useless precisely when needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import statistics
+import sys
+
+_JOURNAL_MOD = None
+
+
+def journal_mod():
+    """``raphtory_tpu/obs/journal.py`` loaded by file path (no package
+    import — ``raphtory_tpu/__init__`` would pull jax)."""
+    global _JOURNAL_MOD
+    if _JOURNAL_MOD is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "obs", "journal.py")
+        spec = importlib.util.spec_from_file_location("rtpu_journal", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _JOURNAL_MOD = mod
+    return _JOURNAL_MOD
+
+
+# ---------------------------------------------------------------- loading
+
+
+def load_segments(directories) -> list[dict]:
+    """Every journal segment under ``directories``, scanned: one dict
+    per segment with its intact records under ``_records``. Unreadable
+    files become ``error`` rows (a half-dead disk is data here)."""
+    jm = journal_mod()
+    segs: list[dict] = []
+    for directory in directories:
+        try:
+            names = sorted(os.listdir(directory))
+        except OSError as e:
+            segs.append({"dir": directory, "error": str(e)})
+            continue
+        for name in names:
+            parsed = jm.parse_segment_name(name)
+            if parsed is None:
+                continue
+            pi, seq = parsed
+            path = os.path.join(directory, name)
+            row = {"dir": directory, "file": name,
+                   "process": pi, "seq": seq}
+            try:
+                records, report = jm.scan_report(path)
+            except OSError as e:
+                row["error"] = str(e)
+                segs.append(row)
+                continue
+            row.update(bytes=report["bytes"], records=len(records),
+                       torn=report["torn"], reason=report["reason"],
+                       _records=records)
+            segs.append(row)
+    segs.sort(key=lambda s: (s.get("process", -1), s.get("seq", -1)))
+    return segs
+
+
+def merge_records(segs, processes=None) -> list[dict]:
+    """One time-ordered cluster timeline: every intact record of every
+    (selected) process, sorted by wall clock (ties: process, then the
+    per-process emit sequence — both monotone within a process)."""
+    out: list[dict] = []
+    for s in segs:
+        if "error" in s:
+            continue
+        if processes is not None and s["process"] not in processes:
+            continue
+        out.extend(s["_records"])
+    out.sort(key=lambda r: (r.get("w", 0.0), r.get("p", 0),
+                            r.get("s", 0)))
+    return out
+
+
+def seq_gaps(records) -> list[dict]:
+    """Gaps in ONE process's emit sequence — the on-disk evidence that
+    records were dropped (queue overflow) or lost with an unflushed
+    batch. The journal assigns sequence numbers even to drops for
+    exactly this reason."""
+    seqs = sorted(r["s"] for r in records if isinstance(r.get("s"), int))
+    gaps = []
+    for a, b in zip(seqs, seqs[1:]):
+        if b > a + 1:
+            gaps.append({"after_seq": a, "missing": b - a - 1})
+    return gaps
+
+
+# ----------------------------------------------------------------- status
+
+
+def status(segs) -> dict:
+    """Per-process inventory + damage report."""
+    procs: dict[int, dict] = {}
+    errors = [s for s in segs if "error" in s]
+    for s in segs:
+        if "error" in s:
+            continue
+        p = procs.setdefault(s["process"], {
+            "segments": 0, "bytes": 0, "records": 0, "torn_segments": 0,
+            "kinds": {}, "first_wall": None, "last_wall": None})
+        p["segments"] += 1
+        p["bytes"] += s["bytes"]
+        p["records"] += len(s["_records"])
+        if s["torn"]:
+            p["torn_segments"] += 1
+        for r in s["_records"]:
+            k = r.get("k", "?")
+            p["kinds"][k] = p["kinds"].get(k, 0) + 1
+            w = r.get("w")
+            if isinstance(w, (int, float)):
+                if p["first_wall"] is None or w < p["first_wall"]:
+                    p["first_wall"] = w
+                if p["last_wall"] is None or w > p["last_wall"]:
+                    p["last_wall"] = w
+    for pi, p in procs.items():
+        mine = [r for s in segs if s.get("process") == pi
+                and "error" not in s for r in s["_records"]]
+        p["seq_gaps"] = seq_gaps(mine)
+        p["dropped_records"] = sum(g["missing"] for g in p["seq_gaps"])
+    out = {"processes": {f"process_{pi}": p
+                         for pi, p in sorted(procs.items())},
+           "segments_total": sum(1 for s in segs if "error" not in s),
+           "records_total": sum(p["records"] for p in procs.values()),
+           "torn_segments_total": sum(p["torn_segments"]
+                                      for p in procs.values())}
+    if errors:
+        out["unreadable"] = [{k: s[k] for k in ("dir", "file", "error")
+                              if k in s} for s in errors]
+    return out
+
+
+# --------------------------------------------------------------- timeline
+
+
+def _summary_of(rec: dict) -> str:
+    d = rec.get("d") or {}
+    if rec.get("k") in ("span", "instant"):
+        name = d.get("name", "?")
+        dur = d.get("dur")
+        return (f"{name} ({dur / 1000.0:.3f} ms)"
+                if isinstance(dur, (int, float)) else name)
+    keys = ("decision", "algorithm", "mode", "site", "state", "reason",
+            "rule", "source", "job_id", "query_id", "metric")
+    bits = [f"{k}={d[k]}" for k in keys if d.get(k) not in (None, "")]
+    return " ".join(bits) if bits else json.dumps(d)[:80]
+
+
+def timeline(records, kind=None, trace=None, tenant=None,
+             since=None, until=None, limit=None) -> list[dict]:
+    out = []
+    for r in records:
+        if kind is not None and r.get("k") != kind:
+            continue
+        if trace is not None and r.get("t") != trace:
+            continue
+        if tenant is not None and r.get("n") != tenant:
+            continue
+        w = r.get("w", 0.0)
+        if since is not None and w < since:
+            continue
+        if until is not None and w > until:
+            continue
+        out.append(r)
+    if limit is not None and len(out) > limit:
+        out = out[-limit:]           # the tail is where postmortems live
+    return out
+
+
+# ------------------------------------------------------------ reconstruct
+
+
+def reconstruct(records, process: int, tail: int = 10) -> dict:
+    """A dead member's final state, from its journal alone."""
+    mine = [r for r in records if r.get("p") == process]
+    out: dict = {"process": process, "records": len(mine)}
+    if not mine:
+        out["error"] = f"no records for process {process}"
+        return out
+    last = mine[-1]
+    out["last_record"] = {"kind": last.get("k"), "wall": last.get("w"),
+                          "seq": last.get("s"),
+                          "summary": _summary_of(last)}
+    out["seq_gaps"] = seq_gaps(mine)
+    metas = [r for r in mine if r.get("k") == "meta"]
+    if metas:
+        out["meta"] = metas[-1]["d"]
+    # the final sweep: the last trace this process touched, replayed as
+    # an ordered timeline (spans journal at COMPLETION, so the last
+    # records of a killed sweep are the phases that finished; the phase
+    # that was mid-flight is the gap after the last span)
+    traced = [r for r in mine
+              if r.get("k") in ("span", "instant") and r.get("t")]
+    if traced:
+        final_trace = traced[-1]["t"]
+        sweep = [r for r in traced if r["t"] == final_trace]
+        out["final_trace"] = {
+            "trace_id": final_trace,
+            "events": [{"kind": r["k"], "wall": r.get("w"),
+                        "name": (r.get("d") or {}).get("name"),
+                        "dur_us": (r.get("d") or {}).get("dur")}
+                       for r in sweep[-50:]],
+        }
+    # last live-epoch state per subscription — the survivor cross-check
+    epochs: dict[str, dict] = {}
+    for r in mine:
+        if r.get("k") == "epoch":
+            d = r.get("d") or {}
+            jid = str(d.get("job_id", "?"))
+            epochs[jid] = {"wall": r.get("w"), **d}
+    if epochs:
+        out["last_epoch_by_job"] = epochs
+    ledgers = [r for r in mine if r.get("k") == "ledger"]
+    if ledgers:
+        out["last_ledgers"] = [
+            {"wall": r.get("w"), "trace": r.get("t"),
+             "algorithm": (r.get("d") or {}).get("algorithm"),
+             "job_id": (r.get("d") or {}).get("job_id"),
+             "status": (r.get("d") or {}).get("status")}
+            for r in ledgers[-tail:]]
+    for kind in ("fault", "breaker", "degrade", "sched", "fresh"):
+        rows = [r for r in mine if r.get("k") == kind]
+        if rows:
+            out[f"last_{kind}"] = [
+                {"wall": r.get("w"), "summary": _summary_of(r)}
+                for r in rows[-tail:]]
+    return out
+
+
+# ---------------------------------------------------------------- exports
+
+
+def chrome_trace(records) -> dict:
+    """Chrome-trace JSON over the merged timeline. Ring-event
+    timestamps are per-process perf_counter epochs — NOT comparable
+    across processes — so every event is re-based onto its journal
+    record's wall clock (spans journal at completion: start = wall −
+    duration). ``pid`` is the cluster process_index, which is what a
+    cross-process view wants on the axis."""
+    events = []
+    for r in records:
+        k = r.get("k")
+        d = r.get("d") or {}
+        w = r.get("w")
+        if k not in ("span", "instant") or not isinstance(w, (int, float)):
+            continue
+        if k == "span":
+            dur = float(d.get("dur") or 0.0)
+            events.append({"ph": "X", "name": d.get("name", "?"),
+                           "ts": w * 1e6 - dur, "dur": dur,
+                           "pid": r.get("p", 0), "tid": d.get("tid", 0),
+                           "args": d.get("args", {})})
+        else:
+            events.append({"ph": "i", "s": "t",
+                           "name": d.get("name", "?"), "ts": w * 1e6,
+                           "pid": r.get("p", 0), "tid": d.get("tid", 0),
+                           "args": d.get("args", {})})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def collapsed_stacks(records) -> dict[str, int]:
+    """``stack self_time_us`` lines for flamegraph tooling. Stacks are
+    parent chains over span ids (per process — span ids are process
+    local); weights are SELF time so a parent's bar doesn't double-count
+    its children."""
+    spans = [r for r in records if r.get("k") == "span"
+             and isinstance((r.get("d") or {}).get("sid"), int)]
+    by_sid: dict[tuple, dict] = {}
+    child_us: dict[tuple, float] = {}
+    for r in spans:
+        d = r["d"]
+        by_sid[(r.get("p", 0), d["sid"])] = r
+        pk = (r.get("p", 0), d.get("parent"))
+        child_us[pk] = child_us.get(pk, 0.0) + float(d.get("dur") or 0.0)
+    lines: dict[str, int] = {}
+    for r in spans:
+        d = r["d"]
+        p = r.get("p", 0)
+        self_us = max(0.0, float(d.get("dur") or 0.0)
+                      - child_us.get((p, d["sid"]), 0.0))
+        stack = [str(d.get("name", "?"))]
+        seen = {d["sid"]}
+        cur = d.get("parent")
+        while cur and (p, cur) in by_sid and cur not in seen:
+            seen.add(cur)
+            parent = by_sid[(p, cur)]["d"]
+            stack.append(str(parent.get("name", "?")))
+            cur = parent.get("parent")
+        stack.append(f"process_{p}")
+        key = ";".join(reversed(stack))
+        lines[key] = lines.get(key, 0) + int(round(self_us))
+    return lines
+
+
+# ------------------------------------------------------------------- diff
+
+
+def _run_profile(records) -> dict:
+    """Medians a run diffs on: per-algorithm per-phase seconds (from
+    ledger records) and per-span-name durations."""
+    phases: dict[str, list[float]] = {}
+    spans: dict[str, list[float]] = {}
+    for r in records:
+        d = r.get("d") or {}
+        if r.get("k") == "ledger":
+            alg = str(d.get("algorithm") or "?")
+            for ph, sec in (d.get("phase_seconds") or {}).items():
+                if isinstance(sec, (int, float)):
+                    phases.setdefault(f"{alg}/{ph}", []).append(float(sec))
+        elif r.get("k") == "span":
+            dur = d.get("dur")
+            if isinstance(dur, (int, float)):
+                spans.setdefault(str(d.get("name", "?")), []).append(
+                    float(dur) / 1e6)
+    return {
+        "phase_seconds": {k: {"median": statistics.median(v), "n": len(v)}
+                          for k, v in phases.items()},
+        "span_seconds": {k: {"median": statistics.median(v), "n": len(v)}
+                         for k, v in spans.items()},
+    }
+
+
+def diff(records_a, records_b, threshold: float = 0.25) -> dict:
+    """Attribute regressions between two runs: every phase/span metric
+    present in BOTH, with relative delta; ``regressed`` when run B's
+    median exceeds run A's by more than ``threshold`` (relative)."""
+    a, b = _run_profile(records_a), _run_profile(records_b)
+    out = {"threshold": threshold, "metrics": {}, "regressions": []}
+    for table in ("phase_seconds", "span_seconds"):
+        for key in sorted(set(a[table]) & set(b[table])):
+            ma, mb = a[table][key]["median"], b[table][key]["median"]
+            delta = (mb - ma) / ma if ma > 0 else 0.0
+            row = {"a_median": round(ma, 6), "b_median": round(mb, 6),
+                   "delta_rel": round(delta, 4),
+                   "n_a": a[table][key]["n"], "n_b": b[table][key]["n"],
+                   "regressed": delta > threshold}
+            out["metrics"][f"{table}:{key}"] = row
+            if row["regressed"]:
+                out["regressions"].append(f"{table}:{key}")
+    out["ok"] = not out["regressions"]
+    return out
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def _parse_processes(spec: str | None):
+    if spec is None:
+        return None
+    return {int(p) for p in spec.split(",") if p.strip()}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="rtpu-postmortem",
+        description="replay durable telemetry journals "
+                    "(obs/journal.py segments) into cluster answers")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def add_common(p, dirs="+"):
+        p.add_argument("journals", nargs=dirs,
+                       help="journal director(ies) of one run")
+        p.add_argument("--process", default=None,
+                       help="restrict to process index(es), comma-sep")
+
+    p = sub.add_parser("status", help="segment inventory + damage report")
+    add_common(p)
+
+    p = sub.add_parser("timeline", help="merged, filtered cluster timeline")
+    add_common(p)
+    p.add_argument("--kind", default=None)
+    p.add_argument("--trace", default=None)
+    p.add_argument("--tenant", default=None)
+    p.add_argument("--since", type=float, default=None,
+                   help="unix seconds lower bound")
+    p.add_argument("--until", type=float, default=None,
+                   help="unix seconds upper bound")
+    p.add_argument("--limit", type=int, default=200,
+                   help="keep the LAST n matches (0 = all)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+
+    p = sub.add_parser("reconstruct",
+                       help="a dead member's final state from its journal")
+    p.add_argument("journals", nargs="+")
+    p.add_argument("--process", type=int, required=True)
+    p.add_argument("--tail", type=int, default=10,
+                   help="rows kept per per-kind tail")
+
+    p = sub.add_parser("export", help="chrome trace / collapsed stacks")
+    add_common(p)
+    p.add_argument("--format", choices=("chrome", "collapsed"),
+                   default="chrome")
+    p.add_argument("--out", default=None, help="output file (default stdout)")
+
+    p = sub.add_parser("diff", help="phase/span regression attribution "
+                                    "between two runs")
+    p.add_argument("run_a", help="journal dir of the baseline run")
+    p.add_argument("run_b", help="journal dir of the candidate run")
+    p.add_argument("--threshold", type=float, default=0.25,
+                   help="relative slowdown that counts as a regression")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "diff":
+        ra = merge_records(load_segments([args.run_a]))
+        rb = merge_records(load_segments([args.run_b]))
+        if not ra or not rb:
+            print("rtpu-postmortem: empty run "
+                  f"(a={len(ra)} b={len(rb)} records)", file=sys.stderr)
+            return 2
+        result = diff(ra, rb, threshold=args.threshold)
+        json.dump(result, sys.stdout, indent=1)
+        print()
+        for key in result["regressions"]:
+            m = result["metrics"][key]
+            print(f"  REGRESSION {key}: {m['a_median']} -> "
+                  f"{m['b_median']} (+{m['delta_rel'] * 100:.1f}%)",
+                  file=sys.stderr)
+        return 0 if result["ok"] else 1
+
+    segs = load_segments(args.journals)
+    if not any("error" not in s for s in segs):
+        print("rtpu-postmortem: no readable journal segments under "
+              f"{args.journals}", file=sys.stderr)
+        return 2
+    procs = (_parse_processes(getattr(args, "process", None))
+             if args.cmd != "reconstruct" else None)
+
+    if args.cmd == "status":
+        json.dump(status(segs), sys.stdout, indent=1)
+        print()
+        return 0
+
+    if args.cmd == "timeline":
+        rows = timeline(merge_records(segs, procs), kind=args.kind,
+                        trace=args.trace, tenant=args.tenant,
+                        since=args.since, until=args.until,
+                        limit=args.limit or None)
+        if args.format == "json":
+            json.dump(rows, sys.stdout, indent=1)
+            print()
+        else:
+            for r in rows:
+                print(f"{r.get('w', 0):.6f} p{r.get('p', '?')} "
+                      f"{r.get('k', '?'):8s} {r.get('t') or '-':14s} "
+                      f"{_summary_of(r)}")
+        return 0
+
+    if args.cmd == "reconstruct":
+        out = reconstruct(merge_records(segs), args.process,
+                          tail=args.tail)
+        json.dump(out, sys.stdout, indent=1)
+        print()
+        return 0 if "error" not in out else 1
+
+    if args.cmd == "export":
+        records = merge_records(segs, procs)
+        if args.format == "chrome":
+            doc = chrome_trace(records)
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump(doc, f)
+            else:
+                json.dump(doc, sys.stdout)
+                print()
+        else:
+            lines = collapsed_stacks(records)
+            text = "".join(f"{k} {v}\n" for k, v in sorted(lines.items()))
+            if args.out:
+                with open(args.out, "w") as f:
+                    f.write(text)
+            else:
+                sys.stdout.write(text)
+        return 0
+
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

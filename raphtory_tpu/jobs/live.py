@@ -50,6 +50,7 @@ import time as _time
 import numpy as np
 
 from ..obs import freshness as _fresh
+from ..obs import journal as _journal
 from ..obs.metrics import METRICS
 from ..obs.trace import TRACER, block_steps as _block_steps
 
@@ -341,6 +342,15 @@ class LiveEpochState:
             delta_rows=delta_rows, ship_bytes=ship_bytes,
             staleness_s=staleness, result_time=t)
         METRICS.live_epochs.labels(alg, mode).inc()
+        if _journal.enabled():
+            _journal.emit("epoch", {
+                "job_id": self.job.id, "algorithm": alg, "mode": mode,
+                "result_time": t, "delta_rows": delta_rows,
+                "ship_bytes": ship_bytes,
+                "staleness_s": (round(staleness, 6)
+                                if staleness is not None else None),
+                "seconds": round(seconds, 6), "served": self.served},
+                trace_id=self.job.trace_id)
         if priced and self.job._sched is not None:
             try:
                 self.job._sched.note_live_epoch(alg, seconds)

@@ -25,6 +25,7 @@ from ..engine import bsp
 from ..engine.program import VertexProgram
 from ..obs import advisor as _advisor
 from ..obs import freshness as _fresh
+from ..obs import journal as _journal
 from ..obs import ledger as _ledger
 from ..obs import slo as _slo
 from ..obs import workload as _workload
@@ -255,6 +256,16 @@ class Job:
         # knob so the bench off-arm pays nothing.
         if _advisor.enabled():
             _advisor.note_query(led.as_dict())
+        # durable journal (obs/journal.py): every COMPLETED query's
+        # ledger lands on disk — like the SLO/workload surfaces this
+        # survives RTPU_LEDGER=0 (the jobs-layer timings are collected
+        # either way), so a postmortem can always price the final sweep
+        if _journal.enabled():
+            snap_j = led.as_dict()
+            snap_j["job_id"] = self.id
+            snap_j["status"] = self.status
+            _journal.emit("ledger", snap_j, trace_id=self.trace_id,
+                          tenant=led.tenant or None)
         # serving-scheduler completion hook (jobs/scheduler.py): release
         # this job's admitted cost from the live backlog and fold its
         # measured seconds-per-view into the admission price book —

@@ -65,6 +65,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..obs import budget as _budget
 from ..obs import device as _device
 from ..obs import freshness as _freshness
+from ..obs import journal as _journal
 from ..obs import ledger as _ledger
 from ..obs import slo as _slo
 from ..obs import workload as _workload
@@ -249,6 +250,10 @@ def _statusz(manager: AnalysisManager,
         # the resilience plane (resilience/): armed failpoints, breaker
         # states, degraded-results tally — the full document is /faultz
         "resilience": _resilience_block(),
+        # the durable journal (obs/journal.py): segment bytes, drops,
+        # flush lag — what /clusterz federates so a mesh-wide postmortem
+        # knows which members have replayable evidence
+        "journal": _journal.status_block(),
         # the distributed half: which process this is, where its
         # listeners actually bound (what /clusterz discovery reads), and
         # what the cross-shard collectives moved
@@ -621,6 +626,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # with injection counts, per-peer breaker states, the
                 # degraded-results ledger — docs/RESILIENCE.md
                 return self._json(200, _faults.faultz())
+            if path == "/journalz":
+                # the durable journal (obs/journal.py): segment
+                # inventory with bytes, drop/error counters, flush lag
+                # — docs/OBSERVABILITY.md "Durable journal"
+                return self._json(200, _journal.journalz())
             if path == "/workloadz":
                 # per-tenant workload accounts (obs/workload.py)
                 return self._json(200, _workload.WORKLOAD.workloadz())

@@ -67,6 +67,7 @@ import weakref
 from ..analysis.sanitizer import (note_shared as _san_note,
                                   track_shared as _san_track)
 from ..obs import budget as _budget
+from ..obs import journal as _journal
 from ..obs import ledger as _ledger
 from ..obs import workload as _workload
 from ..obs.metrics import METRICS
@@ -518,6 +519,12 @@ class ServingScheduler:
                 METRICS.scheduler_deadline_expired.inc()
                 TRACER.instant("sched.deadline", job_id=p.job.id,
                                waited_seconds=round(now - p.enqueued, 4))
+                if _journal.enabled():
+                    _journal.emit("sched", {
+                        "decision": "deadline_expired",
+                        "where": "queue", "job_id": p.job.id,
+                        "waited_seconds": round(now - p.enqueued, 4)},
+                        trace_id=getattr(p.job, "trace_id", None))
                 p.finish("expired")
             else:
                 live.append(p)
@@ -628,6 +635,13 @@ class ServingScheduler:
             jobs=len(take), hops=len(hops), windows=len(wlist),
             cols=total_cols, elapsed_seconds=round(elapsed, 6),
             fold_seconds=round(float(hb.fold_seconds), 6))
+        if _journal.enabled():
+            _journal.emit("sched", {
+                "decision": "batch", "batch": batch_id,
+                "family": fam_name, "jobs": len(take),
+                "cols": total_cols,
+                "elapsed_seconds": round(elapsed, 6),
+                "fold_seconds": round(float(hb.fold_seconds), 6)})
         snap = led.as_dict()
         fold_s = float(hb.fold_seconds)
         # a column REQUESTED BY SEVERAL members splits its cost among
@@ -789,6 +803,9 @@ class ServingScheduler:
                                tenant=tenant, queue_depth=depth,
                                backlog_seconds=round(backlog, 3),
                                priced_cost_seconds=round(est, 4))
+                if _journal.enabled():
+                    _journal.emit("sched", dict(
+                        evidence, decision="shed"), tenant=tenant)
                 raise AdmissionDenied(f"admission shed ({reason}): {why}",
                                       retry_after, evidence)
             return est
@@ -944,6 +961,11 @@ def note_deadline_expired(job) -> None:
     dispatched (the non-batched twin of the scheduler-queue expiry)."""
     METRICS.scheduler_deadline_expired.inc()
     TRACER.instant("sched.deadline", job_id=job.id, where="job_start")
+    if _journal.enabled():
+        _journal.emit("sched", {
+            "decision": "deadline_expired", "where": "job_start",
+            "job_id": job.id},
+            trace_id=getattr(job, "trace_id", None))
     sched = getattr(job, "_sched", None)
     if sched is not None:
         sched._count("deadline_expired")
@@ -966,14 +988,12 @@ def schedulerz() -> dict:
 
 _sched_dump = os.environ.get("RTPU_SCHED_DUMP")
 if _sched_dump:
-    import atexit
     import json as _json
 
-    def _dump_sched(path=_sched_dump):
-        try:
-            with open(path, "w") as f:
-                _json.dump(schedulerz(), f, indent=1)
-        except Exception:
-            pass
+    from ..obs import exitdump as _exitdump
 
-    atexit.register(_dump_sched)
+    def _dump_sched(path=_sched_dump):
+        with open(path, "w") as f:
+            _json.dump(schedulerz(), f, indent=1)
+
+    _exitdump.register("sched", _dump_sched)

@@ -52,6 +52,7 @@ from ..analysis.sanitizer import (note_shared as _san_note,
 from . import budget as _budget
 from . import device as _device
 from . import freshness as _freshness
+from . import journal as _journal
 from . import ledger as _ledger
 from . import workload as _workload
 from .slo import _metrics
@@ -860,6 +861,10 @@ class Advisor:
             TRACER.instant("advisor.finding", rule_id=f["rule_id"],
                            knob=f["knob"], severity=f["severity"],
                            summary=f["summary"])
+            # durable journal: FRESH findings only (a standing finding
+            # re-journaled every tick would be noise, not evidence)
+            if _journal.enabled():
+                _journal.emit("advice", f)
         return findings
 
     # ---- periodic thread ----

@@ -320,6 +320,10 @@ def _peer_summary(status: dict) -> dict:
         # the freshness plane (obs/freshness.py): updates/s, backlog,
         # queryable lag, staleness grade — already compact at the source
         "freshness": status.get("freshness"),
+        # the durable journal (obs/journal.py): where this member's
+        # replayable evidence lives, how much of it, and whether the
+        # writer is keeping up — the postmortem plane's discovery data
+        "journal": status.get("journal"),
     }
 
 
@@ -438,6 +442,44 @@ def _merge_freshness(processes: dict) -> dict:
     return out
 
 
+def _merge_journal(processes: dict) -> dict:
+    """The postmortem plane's discovery view: which members journal,
+    where, how many bytes of evidence each holds, and mesh-wide drop /
+    flush-lag health — so ``rtpu-postmortem`` (and the operator driving
+    it) learns from ONE scrape where every member's replayable history
+    lives, including a member that is about to die."""
+    by_process: dict[str, dict] = {}
+    bytes_total = 0
+    drops_total = 0
+    worst_lag = 0.0
+    enabled = 0
+    for name, p in processes.items():
+        j = p.get("journal") if p.get("reachable") else None
+        if not j:
+            continue
+        if not j.get("enabled"):
+            by_process[name] = {"enabled": False}
+            continue
+        enabled += 1
+        lag = float(j.get("flush_lag_seconds") or 0.0)
+        by_process[name] = {
+            "enabled": True,
+            "dir": j.get("dir"),
+            "segments": j.get("segments"),
+            "bytes": j.get("total_bytes"),
+            "drops": j.get("drops"),
+            "flush_lag_seconds": lag,
+        }
+        bytes_total += int(j.get("total_bytes") or 0)
+        drops_total += int(j.get("drops") or 0)
+        worst_lag = max(worst_lag, lag)
+    return {"processes_enabled": enabled,
+            "bytes_total": bytes_total,
+            "drops_total": drops_total,
+            "worst_flush_lag_seconds": round(worst_lag, 3),
+            "by_process": by_process}
+
+
 def _merge_advisor(processes: dict) -> dict:
     """Every reachable peer's advisor block: total findings + the union
     of firing rule ids with per-process attribution."""
@@ -516,6 +558,7 @@ def clusterz(manager=None, handler=None, trace_id: str | None = None,
         "advisor": _merge_advisor(processes),
         "device": _merge_device(processes),
         "freshness": _merge_freshness(processes),
+        "journal": _merge_journal(processes),
         "stragglers": {
             name: p["collectives"]["barrier_wait_seconds"]
             for name, p in processes.items()

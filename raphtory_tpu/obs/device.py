@@ -661,13 +661,10 @@ def clear() -> None:
 
 _device_dump = os.environ.get("RTPU_DEVICE_DUMP")
 if _device_dump:
-    import atexit
+    from . import exitdump as _exitdump
 
     def _dump_devicez(path=_device_dump):
-        try:
-            with open(path, "w") as f:
-                json.dump(devicez(), f)
-        except Exception:
-            pass
+        with open(path, "w") as f:
+            json.dump(devicez(), f)
 
-    atexit.register(_dump_devicez)
+    _exitdump.register("device", _dump_devicez)

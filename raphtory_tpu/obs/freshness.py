@@ -59,6 +59,7 @@ from collections import deque
 
 from ..analysis.sanitizer import (note_shared as _san_note,
                                   track_shared as _san_track)
+from . import journal as _journal
 from .slo import _Hist, _metrics
 from .trace import TRACER
 
@@ -497,6 +498,11 @@ class FreshnessRegistry:
         for st, lat in observed:   # cached children, outside the lock
             if st.prom is not None:
                 st.prom[4].observe(lat)
+            if _journal.enabled():
+                _journal.emit("fresh", {
+                    "source": st.name,
+                    "queryable_latency_s": round(lat, 6),
+                    "safe_time": self.last_safe})
 
     def note_route(self, owner_counts: dict,
                    pending_events: int = 0) -> None:
@@ -992,13 +998,10 @@ def freshz() -> dict:
 
 _fresh_dump = os.environ.get("RTPU_FRESH_DUMP")
 if _fresh_dump:
-    import atexit
+    from . import exitdump as _exitdump
 
     def _dump_freshz(path=_fresh_dump):
-        try:
-            with open(path, "w") as f:
-                json.dump(freshz(), f, default=str)
-        except Exception:
-            pass
+        with open(path, "w") as f:
+            json.dump(freshz(), f, default=str)
 
-    atexit.register(_dump_freshz)
+    _exitdump.register("fresh", _dump_freshz)

@@ -283,15 +283,12 @@ _sample_dump = os.environ.get("RTPU_SAMPLE_DUMP")
 if _sample_dump or sample_hz() > 0:
     SAMPLER.maybe_start()
 if _sample_dump:
-    import atexit
+    from . import exitdump as _exitdump
 
     def _dump_collapsed(path=_sample_dump):
-        try:
-            text = SAMPLER.collapsed()
-            if text:
-                with open(path, "w") as f:
-                    f.write(text + "\n")
-        except Exception:
-            pass
+        text = SAMPLER.collapsed()
+        if text:
+            with open(path, "w") as f:
+                f.write(text + "\n")
 
-    atexit.register(_dump_collapsed)
+    _exitdump.register("sample", _dump_collapsed)

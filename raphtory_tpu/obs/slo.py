@@ -40,6 +40,8 @@ import time
 import weakref
 from collections import deque
 
+from . import journal as _journal
+
 #: Canopy-style default grid: sub-10ms cache hits through multi-minute
 #: cold scale sweeps, denser where SLOs actually get set
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
@@ -399,6 +401,10 @@ class SeriesRing:
                 row[name] = None
         self._rows.append(row)
         self.samples += 1
+        # durable mirror (obs/journal.py): the 1 Hz saturation series is
+        # exactly the shape a postmortem wants around a death
+        if _journal.enabled():
+            _journal.emit("series", row)
         return row
 
     def _loop(self, stop: threading.Event) -> None:
@@ -478,17 +484,14 @@ def slz_payload(series_last: int = 120) -> dict:
 
 _series_dump = os.environ.get("RTPU_SERIES_DUMP")
 if _series_dump:
-    import atexit
+    from . import exitdump as _exitdump
 
     SERIES.start()
 
     def _dump_series(path=_series_dump):
-        try:
-            with open(path, "w") as f:
-                json.dump({"interval_seconds": SERIES.interval,
-                           "samples": SERIES.samples,
-                           "rows": SERIES.rows()}, f)
-        except Exception:
-            pass
+        with open(path, "w") as f:
+            json.dump({"interval_seconds": SERIES.interval,
+                       "samples": SERIES.samples,
+                       "rows": SERIES.rows()}, f)
 
-    atexit.register(_dump_series)
+    _exitdump.register("series", _dump_series)

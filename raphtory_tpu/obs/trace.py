@@ -61,6 +61,8 @@ import tempfile
 import threading
 import time
 
+from . import journal as _journal
+
 DEFAULT_RING = 4096
 
 
@@ -401,6 +403,12 @@ class Tracer:
         with self._lock:
             self._recorded += 1
             self._ring.append(event)
+        # durable mirror (obs/journal.py): ring events additionally land
+        # in the on-disk journal so a SIGKILLed process's final sweep
+        # survives it. One environ lookup when journaling is off; the
+        # emit itself is a bounded non-blocking queue append.
+        if _journal.enabled():
+            _journal.emit_event(event)
 
     def span(self, name: str, **attrs):
         """Context-manager span; no-op (and ~free) when tracing is off."""
@@ -667,38 +675,12 @@ def block_steps(fn):
 
 _dump_path = os.environ.get("RTPU_TRACE_DUMP")
 if _dump_path:
-    import atexit
+    # the shared exit-artifact registry (obs/exitdump.py): one atexit
+    # hook + one guarded SIGTERM handler for EVERY RTPU_*_DUMP writer
+    from . import exitdump as _exitdump
 
     def _dump_at_exit(path=_dump_path):
-        try:
-            if len(TRACER._ring):
-                TRACER.dump(path)
-        except Exception:
-            pass
+        if len(TRACER._ring):
+            TRACER.dump(path)
 
-    atexit.register(_dump_at_exit)
-
-    def _install_sigterm_dump() -> None:
-        """A wedged run killed by ``timeout`` (SIGTERM) skips atexit under
-        Python's default handler — exactly the case the CI failure
-        artifact exists for. Install a dump-then-default handler, but
-        only from the main thread and only when nothing else has claimed
-        SIGTERM (a server's own shutdown handler must win)."""
-        try:
-            import signal
-
-            if (threading.current_thread() is not threading.main_thread()
-                    or signal.getsignal(signal.SIGTERM)
-                    is not signal.SIG_DFL):
-                return
-
-            def _on_term(signum, frame):
-                _dump_at_exit()
-                signal.signal(signal.SIGTERM, signal.SIG_DFL)
-                os.kill(os.getpid(), signal.SIGTERM)   # keep exit code 143
-
-            signal.signal(signal.SIGTERM, _on_term)
-        except Exception:
-            pass
-
-    _install_sigterm_dump()
+    _exitdump.register("trace", _dump_at_exit)

@@ -57,6 +57,14 @@ def _note_state(name: str, state: str, failures: int) -> None:
                        failures=failures)
     except Exception:
         pass
+    try:
+        from ..obs import journal
+
+        if journal.enabled():
+            journal.emit("breaker", {"peer": name, "state": state,
+                                     "failures": failures})
+    except Exception:
+        pass
 
 
 class CircuitBreaker:

@@ -45,6 +45,15 @@ class DegradedLedger:
                            total=total)
         except Exception:
             pass
+        try:
+            from ..obs import journal
+
+            if journal.enabled():
+                journal.emit("degrade", {
+                    "job_id": str(job_id), "reason": reason,
+                    "covered_time": covered_time, "total": total})
+        except Exception:
+            pass
 
     def recent(self, window_s: float) -> int:
         """Degraded results served inside the trailing window."""

@@ -179,6 +179,16 @@ def _instant(name: str, **attrs) -> None:
         pass
 
 
+def _journal_emit(kind: str, data: dict) -> None:
+    try:
+        from ..obs import journal
+
+        if journal.enabled():
+            journal.emit(kind, data)
+    except Exception:   # durability must never become a second fault
+        pass
+
+
 def fire(site: str) -> None:
     """The failpoint check. Disarmed: one global load, returns. Armed:
     roll the site's seeded RNG; inject by raising / sleeping."""
@@ -198,6 +208,7 @@ def fire(site: str) -> None:
     # the injection itself happens OUTSIDE the registry lock: a hang
     # must stall the caller, not every other failpoint in the process
     _instant("fault.inject", site=site, mode=mode, n=n)
+    _journal_emit("fault", {"site": site, "mode": mode, "n": n})
     if mode == "error":
         raise FaultError(f"UNAVAILABLE: injected fault at {site} (#{n})")
     time.sleep(hang_s() if mode == "hang" else slow_s())
@@ -228,14 +239,12 @@ arm()
 
 _fault_dump = os.environ.get("RTPU_FAULT_DUMP")
 if _fault_dump:
-    import atexit
     import json as _json
 
-    def _dump_faultz(path=_fault_dump):
-        try:
-            with open(path, "w") as f:
-                _json.dump(faultz(), f, indent=1)
-        except Exception:
-            pass
+    from ..obs import exitdump as _exitdump
 
-    atexit.register(_dump_faultz)
+    def _dump_faultz(path=_fault_dump):
+        with open(path, "w") as f:
+            _json.dump(faultz(), f, indent=1)
+
+    _exitdump.register("fault", _dump_faultz)

@@ -22,12 +22,24 @@ serving REST on its own port with the other configured as a
   injection hop is deterministic) kills hop 3 of 3: the reply is
   ``degraded: true`` with the covered-time watermark, ``/healthz``
   grades ``degraded``, ``/faultz`` carries the injection count;
+* **postmortem** — the victim's durable journal (obs/journal.py; both
+  workers run with ``RTPU_JOURNAL=1`` into a shared directory) is
+  replayed by ``tools/rtpu-postmortem`` FROM THE DISK ALONE: the
+  reconstruction must recover the victim's last journaled live-epoch
+  state (it was serving a ``live_sub`` subscription when killed) and
+  the survivor's view must agree with it — both members ingested the
+  identical stream, so the victim's final ``result_time`` must equal
+  the head the survivor still serves. A torn final record (the SIGKILL
+  tearing a mid-write frame) must be skipped by CRC, never fatal;
 * **rejoin** — worker 1 restarts on the same port; after the breaker
   window (``RTPU_BREAKER_WINDOW_S=1``) one half-open probe succeeds,
   the breaker closes, and ``/clusterz`` shows both members reachable
-  again.
+  again. The restarted member's journal must CONTINUE segment
+  numbering past its dead predecessor's — crash evidence is never
+  clobbered by a rejoin.
 
-The phase snapshots are written to ``--out`` (the CI failure artifact).
+The phase snapshots are written to ``--out`` (the CI failure artifact);
+``--journal-dir`` keeps the journal segments somewhere CI can upload.
 Exit 0 prints CHAOS_OK; any assertion prints the evidence and exits 1.
 """
 
@@ -100,7 +112,8 @@ def worker(idx: int, port: int) -> None:
     from raphtory_tpu.ingestion.pipeline import IngestionPipeline
     from raphtory_tpu.ingestion.source import IterableSource
     from raphtory_tpu.ingestion.updates import EdgeAdd
-    from raphtory_tpu.jobs.manager import AnalysisManager, RangeQuery
+    from raphtory_tpu.jobs.manager import (AnalysisManager, LiveQuery,
+                                           RangeQuery)
     from raphtory_tpu.jobs import registry
     from raphtory_tpu.jobs.rest import RestServer
 
@@ -117,6 +130,11 @@ def worker(idx: int, port: int) -> None:
         # 150 hops of DegreeBasic keeps the job running for seconds
         mgr.submit(registry.resolve("DegreeBasic", {}),
                    RangeQuery(0, 300, 2), job_id="long_sweep")
+        # a live subscription whose per-epoch accounting lands in the
+        # durable journal — the state the driver's postmortem phase
+        # must reconstruct from disk after the SIGKILL
+        mgr.submit(registry.resolve("DegreeBasic", {}),
+                   LiveQuery(repeat=0.2), job_id="live_sub")
     print(f"WORKER_UP {idx}", flush=True)
     while True:   # serve until the driver kills us (that IS the test)
         time.sleep(1.0)
@@ -124,7 +142,8 @@ def worker(idx: int, port: int) -> None:
 
 # ----------------------------------------------------------------- driver
 
-def _spawn(idx: int, ports: list[int], with_faults: bool):
+def _spawn(idx: int, ports: list[int], with_faults: bool,
+           journal_dir: str | None = None):
     env = dict(
         os.environ,
         PYTHONPATH=_REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
@@ -141,6 +160,15 @@ def _spawn(idx: int, ports: list[int], with_faults: bool):
         env["RTPU_FAULTS"] = _FAULT_SPEC
     else:
         env.pop("RTPU_FAULTS", None)
+    if journal_dir is not None:
+        # both members journal into ONE shared directory (segments are
+        # per-process-named, so they never race each other's rotation);
+        # tracing on so the victim's final sweep is span-level evidence,
+        # short flush so evidence lands before the SIGKILL
+        env["RTPU_JOURNAL"] = "1"
+        env["RTPU_JOURNAL_DIR"] = journal_dir
+        env["RTPU_JOURNAL_FLUSH_MS"] = "50"
+        env["RTPU_TRACE"] = "1"
     return subprocess.Popen(
         [sys.executable, os.path.abspath(__file__),
          "--worker", str(idx), "--port", str(ports[idx])],
@@ -154,16 +182,21 @@ def _peer_row(cz: dict, url: str) -> dict | None:
     return cz["processes"].get(url)
 
 
-def run_smoke(out: str | None, timeout_s: float) -> int:
+def run_smoke(out: str | None, timeout_s: float,
+              journal_dir: str | None = None) -> int:
+    import tempfile
+
     ports = [_free_port(), _free_port()]
     b0 = f"http://127.0.0.1:{ports[0]}"
     b1 = f"http://127.0.0.1:{ports[1]}"
     peer1_url = b1
-    art: dict = {"ports": ports, "fault_spec": _FAULT_SPEC, "phases": {}}
+    jdir = journal_dir or tempfile.mkdtemp(prefix="chaos-journal-")
+    art: dict = {"ports": ports, "fault_spec": _FAULT_SPEC,
+                 "journal_dir": jdir, "phases": {}}
     procs: list = [None, None]
     try:
-        procs[0] = _spawn(0, ports, with_faults=True)
-        procs[1] = _spawn(1, ports, with_faults=False)
+        procs[0] = _spawn(0, ports, with_faults=True, journal_dir=jdir)
+        procs[1] = _spawn(1, ports, with_faults=False, journal_dir=jdir)
         _wait_http(f"{b0}/statusz", timeout_s)
         _wait_http(f"{b1}/statusz", timeout_s)
 
@@ -180,7 +213,23 @@ def run_smoke(out: str | None, timeout_s: float) -> int:
             lambda: (lambda j: j if j.get("long_sweep") == "running"
                      else None)(_http_json(f"{b1}/Jobs")),
             "worker 1 sweep running", timeout_s)
-        art["phases"]["kill"] = {"jobs_on_victim": jobs1}
+        # the victim must have JOURNALED at least one live epoch before
+        # it dies — that record is what the postmortem phase recovers
+        fz1 = _wait_for(
+            lambda: (lambda f: f if (f.get("live_subscriptions", {})
+                                     .get("live_sub", {})
+                                     .get("epochs", 0)) >= 1 else None)(
+                _http_json(f"{b1}/freshz")),
+            "worker 1 live epoch served", timeout_s)
+        victim_epoch_live = fz1["live_subscriptions"]["live_sub"]
+        jz1 = _http_json(f"{b1}/journalz")
+        assert jz1.get("enabled") and jz1.get("records_written", 0) > 0, jz1
+        time.sleep(0.2)   # > RTPU_JOURNAL_FLUSH_MS: the epoch is on disk
+        art["phases"]["kill"] = {"jobs_on_victim": jobs1,
+                                 "victim_journalz": {
+                                     k: jz1.get(k) for k in
+                                     ("records_written", "bytes_written",
+                                      "drops", "segments")}}
         procs[1].send_signal(signal.SIGKILL)
         procs[1].wait(10)
 
@@ -202,6 +251,47 @@ def run_smoke(out: str | None, timeout_s: float) -> int:
         art["phases"]["auto_down"] = {
             "row": row, "gated_scrape_seconds": round(gated_s, 3),
             "last_seen_seconds_ago": row.get("last_seen_seconds_ago")}
+
+        # ---- phase 3b: postmortem — the victim's journal, replayed
+        # from disk alone, must recover its final state, and the
+        # survivor must agree with it
+        def _pm(*pm_args):
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(_REPO, "tools", "rtpu-postmortem"),
+                 *pm_args], capture_output=True, text=True)
+            assert r.returncode == 0, (pm_args, r.stdout[-500:],
+                                       r.stderr[-500:])
+            return json.loads(r.stdout)
+
+        pm_status = _pm("status", jdir)
+        victim = pm_status["processes"].get("process_1")
+        assert victim and victim["records"] > 0, pm_status
+        # torn-tail recovery: the SIGKILL may have torn the final frame
+        # — the replay must have SKIPPED it (counted, rc 0), never died
+        rec = _pm("reconstruct", jdir, "--process", "1")
+        epochs = rec.get("last_epoch_by_job", {})
+        assert "live_sub" in epochs, sorted(rec)
+        assert epochs["live_sub"]["algorithm"] == "DegreeBasic", epochs
+        # survivor cross-check: identical streams on both members, so
+        # the victim's last journaled epoch must sit at the head the
+        # SURVIVOR still serves — and at the result time the victim
+        # itself last reported over REST before it died
+        sz0 = _http_json(f"{b0}/statusz")
+        assert int(epochs["live_sub"]["result_time"]) \
+            == int(sz0["latest_time"]), (epochs, sz0["latest_time"])
+        assert int(epochs["live_sub"]["result_time"]) \
+            == int(victim_epoch_live["last_result_time"]), (
+                epochs, victim_epoch_live)
+        assert rec.get("final_trace", {}).get("events"), sorted(rec)
+        art["phases"]["postmortem"] = {
+            "victim_segments": victim["segments"],
+            "victim_records": victim["records"],
+            "torn_segments": victim["torn_segments"],
+            "dropped_records": victim["dropped_records"],
+            "last_epoch": epochs["live_sub"],
+            "survivor_latest_time": sz0["latest_time"],
+            "final_trace_events": len(rec["final_trace"]["events"])}
 
         # ---- phase 4: survivor serves DEGRADED under the committed
         # schedule (hop 3 of 3 dies; hops 1–2 ship, covered watermark)
@@ -230,7 +320,7 @@ def run_smoke(out: str | None, timeout_s: float) -> int:
             "healthz_status": hz["status"], "faultz_sites": fz["sites"]}
 
         # ---- phase 5: rejoin — breaker half-open probe closes ----
-        procs[1] = _spawn(1, ports, with_faults=False)
+        procs[1] = _spawn(1, ports, with_faults=False, journal_dir=jdir)
         _wait_http(f"{b1}/statusz", timeout_s)
 
         def _rejoined():
@@ -244,9 +334,19 @@ def run_smoke(out: str | None, timeout_s: float) -> int:
         fz = _http_json(f"{b0}/faultz")
         br = fz["breakers"].get(peer1_url, {})
         assert br.get("state") == "closed", fz["breakers"]
+        # the restarted member CONTINUES segment numbering past its dead
+        # predecessor — the crash evidence postmortem just read must
+        # still be on disk, not clobbered by the rejoin
+        pre_seqs = {s["seq"] for s in jz1.get("segments", [])}
+        jz1b = _http_json(f"{b1}/journalz")
+        post_seqs = {s["seq"] for s in jz1b.get("segments", [])}
+        assert pre_seqs <= post_seqs, (pre_seqs, post_seqs)
+        assert max(post_seqs) > max(pre_seqs), (pre_seqs, post_seqs)
         art["phases"]["rejoin"] = {
             "processes_reachable": cz["processes_reachable"],
-            "breaker": br}
+            "breaker": br,
+            "victim_segments_before_kill": sorted(pre_seqs),
+            "segments_after_rejoin": sorted(post_seqs)}
     finally:
         for p in procs:
             if p is not None and p.poll() is None:
@@ -263,12 +363,15 @@ def main(argv=None) -> int:
     ap.add_argument("--worker", type=int, default=None)
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--out", default=None)
+    ap.add_argument("--journal-dir", default=None,
+                    help="shared journal directory (default: a tempdir; "
+                         "CI passes a path it uploads as an artifact)")
     ap.add_argument("--timeout", type=float, default=60.0)
     args = ap.parse_args(argv)
     if args.worker is not None:
         worker(args.worker, args.port)
         return 0
-    return run_smoke(args.out, args.timeout)
+    return run_smoke(args.out, args.timeout, journal_dir=args.journal_dir)
 
 
 if __name__ == "__main__":
