@@ -18,22 +18,30 @@ Two halves:
   interprocedural: a module-resolving call graph, inferred thread
   roots, and reaching locksets power RT009–RT011 and the cross-module
   halves of RT001/RT003/RT004; ``fixes.py`` adds the RT008 ``--fix``
-  autofix. Rule catalogue and suppression syntax:
-  ``docs/STATIC_ANALYSIS.md``.
-* **Lock sanitizer** (``sanitizer.py``) — ``RTPU_SANITIZE=1`` wraps
+  autofix. v3 adds the device-contract passes
+  (``devicecontract.py``): RT012 collectives under per-process control
+  flow, RT013 unstable compile-cache keys, RT014 donated/resident
+  buffer escapes, RT015 device ops on the ingest path. Rule catalogue
+  and suppression syntax: ``docs/STATIC_ANALYSIS.md``.
+* **Runtime sanitizers** (``sanitizer.py``) — ``RTPU_SANITIZE=1`` wraps
   ``threading.Lock``/``RLock`` to build a lock-ordering graph, reports
   cycles (potential deadlocks), locks held across ``device_put`` /
   ``device_get`` / ``block_until_ready`` boundaries, and Eraser-style
   lockset races over registered shared structures (``track_shared``),
-  mirroring findings into the ``obs.trace`` flight recorder. Zero
-  overhead when the env var is unset: nothing is patched.
+  mirroring findings into the ``obs.trace`` flight recorder. The same
+  switch arms the mesh-divergence sanitizer: per-process dispatch
+  fingerprint rings cross-checked on ``/clusterz``, plus a
+  barrier-stall watchdog (``RTPU_SANITIZE_BARRIER_S``). Zero overhead
+  when the env var is unset: nothing is patched.
 """
 
 from __future__ import annotations
 
 from .findings import Baseline, Finding
 from .rules import RULES, analyze_module, analyze_project
-from .sanitizer import LockSanitizer, install, track_shared, uninstall
+from .sanitizer import (LockSanitizer, MeshSanitizer, install,
+                        mesh_active, mesh_prefix_divergence, track_shared,
+                        uninstall)
 
 __all__ = [
     "Baseline",
@@ -42,7 +50,10 @@ __all__ = [
     "analyze_module",
     "analyze_project",
     "LockSanitizer",
+    "MeshSanitizer",
     "install",
+    "mesh_active",
+    "mesh_prefix_divergence",
     "track_shared",
     "uninstall",
 ]

@@ -1,0 +1,617 @@
+"""Device-contract rules (RT012–RT015): the SPMD/compile/buffer/ingest
+invariants the multi-process mesh push depends on.
+
+The single worst failure mode past N=2 processes is the silent SPMD hang:
+one process takes a branch the others don't and dispatches a different
+collective sequence, so the mesh blocks forever with no error (the
+reference's BSP layer assumes lock-step dispatch across all partition
+managers). These rules encode the static half of that contract over the
+:class:`~.interproc.Project` model; the runtime half — the mesh-divergence
+fingerprint ring and barrier watchdog — lives in ``sanitizer.py``.
+
+* **RT012 collective-under-divergent-control-flow** — a mesh dispatch
+  reachable under a branch conditioned on per-process data
+  (``process_index()``, measured timings, breaker/advisor state, env
+  reads). Silenced only by ``# rtpulint: spmd-uniform — <why>`` with a
+  NON-EMPTY justification: the pragma is an assertion, not a mute.
+* **RT013 unstable-compile-key** — an ``lru_cache``'d compiled-program
+  factory keyed on a float-fresh/unhashable/identity-keyed value, or
+  whose traced body reads state the key does not carry (generalizes
+  RT001 beyond env reads — the compile-storm / wrong-program-reuse
+  class).
+* **RT014 resident-buffer-escape** — a donated arg captured by a closure
+  or stored into a container/attribute that outlives its dispatch
+  (extends the RT004 ``_donate_flow`` core to pre-donate captures, the
+  half RT004's read-after-donate dataflow cannot see).
+* **RT015 device-op-on-ingest-path** — jax calls reachable from the
+  pipeline-sink/watermark/freshness chains, which must stay
+  numpy/stdlib (the ≤5% ingest-overhead budget depends on it).
+
+Precision-first like every other pass: anything the resolver is not
+confident about is skipped, because the baseline is kept empty and every
+finding costs a source fix or a reviewed pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .concurrency import _chain_str, _dedupe, _finding, _qualname_of
+from .findings import Finding, parse_spmd_uniform
+from .interproc import FuncInfo, Project
+from .rules import (Module, _ancestors, _dotted, _enclosing_def,
+                    _env_read_var, _is_cached_def, _is_jit_call,
+                    _module_mutables, _traced_defs)
+
+#: calls that ARE a mesh dispatch / collective: the jax collective
+#: vocabulary plus the cross-host replication entry point. A call that
+#: RESOLVES to a function containing one of these (transitively) counts
+#: as a dispatch site too — that is how ``sharded.run`` / the sparse
+#: route / any future collective is covered without naming it here.
+_MESH_DISPATCH_TAILS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pbroadcast", "pshuffle", "shard_map",
+    "process_allgather",
+}
+
+#: wall-clock sources whose values differ per process — a branch on a
+#: measured duration is the classic accidental divergence
+_TIMING_CALLS = {
+    "time.perf_counter", "perf_counter", "time.perf_counter_ns",
+    "time.monotonic", "monotonic", "time.monotonic_ns", "time.time",
+}
+
+#: attribute-chain substrings that mark per-process runtime state
+#: (breaker trips and advisor decisions are driven by local timings)
+_STATE_MARKERS = ("breaker", "advisor")
+
+
+# ---------------------------------------------------------------------------
+# shared call resolution (RT012/RT013/RT014 each classify most call
+# sites in the project — one memoised resolve keeps the three passes
+# inside the CI lint budget instead of re-running the resolver 3x)
+
+
+def _resolve_cached(project: Project, mod: Module, call: ast.Call):
+    cache = project.__dict__.setdefault("_devicecontract_resolve", {})
+    key = id(call)
+    if key not in cache:
+        cache[key] = project.resolve_call(mod, _enclosing_def(call), call)
+    return cache[key]
+
+
+# ---------------------------------------------------------------------------
+# RT012 collective-under-divergent-control-flow
+
+
+def _dispatch_call_graph(project: Project) -> dict:
+    """function key → set of resolvable callee keys (each call resolved
+    once; shared by the dispatch fixpoint and the site classification)."""
+    calls: dict[tuple, set] = {}
+    for fi in project.functions.values():
+        callees = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                callee = _resolve_cached(project, fi.mod, node)
+                if callee is not None and callee.key != fi.key:
+                    callees.add(callee.key)
+        calls[fi.key] = callees
+    return calls
+
+
+def _direct_dispatcher(fn_node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call)
+               and _dotted(n.func).split(".")[-1] in _MESH_DISPATCH_TAILS
+               for n in ast.walk(fn_node))
+
+
+def _dispatching_keys(project: Project, calls: dict) -> set:
+    """Fixpoint closure of "contains a mesh dispatch" over the resolved
+    call graph: ``sweep.ShardedSweep.run`` dispatches because
+    ``sharded.run`` does."""
+    disp = {fi.key for fi in project.functions.values()
+            if _direct_dispatcher(fi.node)}
+    changed = True
+    while changed:
+        changed = False
+        for key, callees in calls.items():
+            if key not in disp and callees & disp:
+                disp.add(key)
+                changed = True
+    return disp
+
+
+def _taint_label(node: ast.AST, tainted: set[str]) -> str | None:
+    """A short label when ``node`` (an expression) depends on per-process
+    data, else None. Sources: ``process_index`` (call or attribute),
+    wall-clock timing calls, env reads, breaker/advisor state, and local
+    names already marked tainted."""
+    for sub in ast.walk(node):
+        var = _env_read_var(sub)
+        if var is not None:
+            return f"env read {var or '<dynamic>'!r}"
+        if isinstance(sub, ast.Call):
+            d = _dotted(sub.func)
+            if d.split(".")[-1] == "process_index":
+                return "process_index()"
+            if d in _TIMING_CALLS:
+                return f"{d}() timing"
+        elif isinstance(sub, ast.Attribute):
+            if sub.attr == "process_index":
+                return f"{_dotted(sub) or '.process_index'}"
+            low = _dotted(sub).lower()
+            if any(m in low for m in _STATE_MARKERS):
+                return f"{_dotted(sub)} state"
+        elif isinstance(sub, ast.Name) and sub.id in tainted:
+            return f"{sub.id!r} (per-process value)"
+    return None
+
+
+def _tainted_names(fn_node: ast.AST) -> set[str]:
+    """Local names (in ``fn_node``'s whole subtree, closures included)
+    assigned from per-process expressions, to a fixpoint so
+    ``t0 = perf_counter(); dt = now - t0; slow = dt > x`` chains taint."""
+    tainted: set[str] = set()
+    for _ in range(4):
+        before = len(tainted)
+        for sub in ast.walk(fn_node):
+            value = targets = None
+            if isinstance(sub, ast.Assign):
+                value, targets = sub.value, sub.targets
+            elif isinstance(sub, ast.AugAssign):
+                value, targets = sub.value, [sub.target]
+            elif isinstance(sub, ast.NamedExpr):
+                value, targets = sub.value, [sub.target]
+            if value is None or _taint_label(value, tainted) is None:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+        if len(tainted) == before:
+            break
+    return tainted
+
+
+def check_collective_divergence(project: Project) -> list[Finding]:
+    """RT012: a mesh dispatch (collective call, or call into a function
+    that transitively dispatches one) under a branch/loop conditioned on
+    per-process data. If any process takes a different arm, the
+    collective sequences diverge and the mesh blocks forever with no
+    error. A genuinely uniform site is declared
+    ``# rtpulint: spmd-uniform — <why>`` on the dispatch line or the
+    branch line; the justification is enforced non-empty."""
+    calls = _dispatch_call_graph(project)
+    disp = _dispatching_keys(project, calls)
+    out: list[Finding] = []
+    spmd_by_mod = {m.relpath: parse_spmd_uniform(m.lines)
+                   for m in project.modules}
+    for fi in sorted(project.functions.values(),
+                     key=lambda f: (f.mod.relpath, f.node.lineno)):
+        mod = fi.mod
+        spmd = spmd_by_mod[mod.relpath]
+        sites: list[tuple] = []
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _dotted(node.func).split(".")[-1]
+            label = None
+            if tail in _MESH_DISPATCH_TAILS:
+                label = tail
+            else:
+                callee = _resolve_cached(project, mod, node)
+                if callee is not None and callee.key in disp and \
+                        callee.key != fi.key:
+                    label = callee.label
+            if label is not None:
+                sites.append((node, label))
+        if not sites:
+            continue   # taint is computed only where a dispatch exists
+        tainted = _tainted_names(fi.node)
+        for node, label in sites:
+            branch = why = None
+            for anc in _ancestors(node):
+                if anc is fi.node:
+                    break
+                test = None
+                if isinstance(anc, (ast.If, ast.While, ast.IfExp)):
+                    test = anc.test
+                elif isinstance(anc, (ast.For, ast.AsyncFor)):
+                    test = anc.iter
+                if test is None or any(s is node for s in ast.walk(test)):
+                    continue   # the dispatch IS the condition — it runs
+                why = _taint_label(test, tainted)
+                if why is not None:
+                    branch = anc
+                    break
+            if branch is None:
+                continue
+            just = spmd.get(node.lineno)
+            if just is None:
+                just = spmd.get(branch.lineno)
+            if just:
+                continue   # reviewed uniformity assertion — honoured
+            empty_pragma = (
+                " (an spmd-uniform pragma is present but its "
+                "justification is EMPTY — write why every process takes "
+                "the same arm)") if just is not None else ""
+            out.append(_finding(
+                mod, "RT012", node,
+                f"mesh dispatch {label!r} is reachable under a branch "
+                f"conditioned on per-process data ({why}, line "
+                f"{branch.lineno}) — if any process takes a different "
+                f"arm the collective sequences diverge and the mesh "
+                f"hangs; make the condition SPMD-uniform, hoist the "
+                f"dispatch, or declare the site "
+                f"`# rtpulint: spmd-uniform — <why>`{empty_pragma}",
+                symbol=_qualname_of(mod, node)))
+    return _dedupe(out)
+
+
+# ---------------------------------------------------------------------------
+# RT013 unstable-compile-key
+
+
+def _compile_factories(project: Project) -> list[FuncInfo]:
+    """``lru_cache``'d functions that build compiled programs (contain a
+    ``jax.jit``/``shard_map`` call) — the repo's compiled-factory idiom.
+    Plain lru_caches of host data are out of scope: their keys cannot
+    cause a compile storm."""
+    out = []
+    for fi in project.functions.values():
+        if not _is_cached_def(fi.node):
+            continue
+        if any(isinstance(n, ast.Call)
+               and (_is_jit_call(n)
+                    or _dotted(n.func).split(".")[-1] == "shard_map")
+               for n in ast.walk(fi.node)):
+            out.append(fi)
+    return out
+
+
+def _factory_traced_defs(mod: Module, fi: FuncInfo) -> list:
+    """Inner defs of ``fi`` that become compiled code: jit-decorated or
+    jit-called (via ``_traced_defs``) plus defs passed by name into a
+    ``shard_map``/``_shard_map`` call — the SPMD factory shape, where
+    the shard_mapped fn is jitted as a value (``jax.jit(fn)``) and the
+    name-based jit scan cannot see it."""
+    inner = [t for t in _traced_defs(mod)
+             if any(a is fi.node for a in _ancestors(t))]
+    seen = {id(t) for t in inner}
+    by_name: dict[str, list] = {}
+    for n in ast.walk(fi.node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                n is not fi.node:
+            by_name.setdefault(n.name, []).append(n)
+    for n in ast.walk(fi.node):
+        if isinstance(n, ast.Call) and \
+                _dotted(n.func).split(".")[-1] in ("shard_map",
+                                                   "_shard_map"):
+            for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                if isinstance(arg, ast.Name):
+                    for d in by_name.get(arg.id, []):
+                        if id(d) not in seen:
+                            inner.append(d)
+                            seen.add(id(d))
+    return inner
+
+
+def _fn_params(defnode) -> set[str]:
+    a = defnode.args
+    params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        params.add(a.vararg.arg)
+    if a.kwarg:
+        params.add(a.kwarg.arg)
+    return params
+
+
+def _unstable_arg_label(arg: ast.AST, timing_locals: set[str]) -> str | None:
+    """Why ``arg`` destabilises an lru_cache key, or None."""
+    if isinstance(arg, ast.Lambda):
+        return ("a lambda is identity-keyed — every call builds a new "
+                "key, the cache never hits, and each dispatch recompiles")
+    if isinstance(arg, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                        ast.DictComp, ast.SetComp)):
+        return "an unhashable container literal cannot be a cache key"
+    if isinstance(arg, ast.Call) and _dotted(arg.func) in _TIMING_CALLS:
+        return ("a measured timing is a fresh float every call — every "
+                "dispatch makes a new key and recompiles (compile storm)")
+    if isinstance(arg, ast.Name) and arg.id in timing_locals:
+        return (f"{arg.id!r} holds a measured timing — a fresh float "
+                f"every call; every dispatch makes a new key and "
+                f"recompiles (compile storm)")
+    return None
+
+
+def _timing_locals(fn_node: ast.AST) -> set[str]:
+    """Names assigned from wall-clock calls (or arithmetic over them)
+    inside ``fn_node`` — candidate compile-storm key components."""
+    tainted: set[str] = set()
+    for _ in range(3):
+        before = len(tainted)
+        for sub in ast.walk(fn_node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            hit = any(
+                (isinstance(s, ast.Call) and _dotted(s.func)
+                 in _TIMING_CALLS)
+                or (isinstance(s, ast.Name) and s.id in tainted)
+                for s in ast.walk(sub.value))
+            if hit:
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+        if len(tainted) == before:
+            break
+    return tainted
+
+
+def check_unstable_compile_key(project: Project) -> list[Finding]:
+    """RT013: compiled-program factories with unstable or incomplete
+    cache keys. Two halves: (a) the traced body reads module-level
+    mutable state the key does not carry — the wrong-program-reuse bug
+    (the value is baked in at trace time, then the stale program is
+    replayed after the state changes); (b) a call site passes a key
+    component that is fresh per call (timing float, lambda) or
+    unhashable — the compile-storm bug. Generalizes RT001 beyond env
+    reads."""
+    out: list[Finding] = []
+    factories = _compile_factories(project)
+    factory_keys = {fi.key for fi in factories}
+
+    # (a) traced bodies reading un-keyed module mutables
+    for fi in sorted(factories, key=lambda f: (f.mod.relpath,
+                                               f.node.lineno)):
+        mod = fi.mod
+        mutables = _module_mutables(mod)
+        if not mutables:
+            continue
+        for inner in _factory_traced_defs(mod, fi):
+            shadowed = _fn_params(inner) | {
+                n.id for n in ast.walk(inner)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)}
+            for node in ast.walk(inner):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in mutables and node.id not in shadowed:
+                    out.append(_finding(
+                        mod, "RT013", node,
+                        f"traced body {inner.name!r} reads module-level "
+                        f"mutable {node.id!r}, which is baked in at "
+                        f"trace time but is NOT part of lru_cache'd "
+                        f"{fi.node.name!r}'s key — the cached program "
+                        f"silently replays the stale value; thread it "
+                        f"in as a factory argument",
+                        symbol=_qualname_of(mod, node)))
+
+    # (b) unstable key components at factory call sites
+    if not factory_keys:
+        return _dedupe(out)
+    for fi in sorted(project.functions.values(),
+                     key=lambda f: (f.mod.relpath, f.node.lineno)):
+        mod = fi.mod
+        timing = None   # computed lazily: most functions call no factory
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _resolve_cached(project, mod, node)
+            if callee is None or callee.key not in factory_keys:
+                continue
+            if timing is None:
+                timing = _timing_locals(fi.node)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                why = _unstable_arg_label(arg, timing)
+                if why is None:
+                    continue
+                out.append(_finding(
+                    mod, "RT013", arg,
+                    f"unstable cache-key component passed to compiled-"
+                    f"program factory {callee.node.name!r}: {why}; pass "
+                    f"a stable, hashable value (quantise timings, hoist "
+                    f"callables to module scope)",
+                    symbol=_qualname_of(mod, node)))
+    return _dedupe(out)
+
+
+# ---------------------------------------------------------------------------
+# RT014 resident-buffer-escape
+
+
+_STORE_METHODS = {"append", "add", "insert", "appendleft", "setdefault",
+                  "put", "put_nowait"}
+
+
+def _donor_calls(fn_node: ast.AST, donors: dict[str, set]):
+    """(call node, donated-arg Name) pairs inside ``fn_node``."""
+    for node in ast.walk(fn_node):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in donors):
+            continue
+        for idx in sorted(donors[node.func.id]):
+            if idx < len(node.args) and isinstance(node.args[idx], ast.Name):
+                yield node, node.args[idx]
+
+
+def _name_free_in(defnode, name: str) -> bool:
+    """True when ``name`` is read free (closure-captured) inside the
+    nested def/lambda ``defnode``."""
+    if name in _fn_params(defnode):
+        return False
+    body = defnode.body if isinstance(defnode, ast.Lambda) \
+        else ast.Module(body=defnode.body, type_ignores=[])
+    assigned = any(isinstance(n, ast.Name) and n.id == name
+                   and isinstance(n.ctx, (ast.Store, ast.Del))
+                   for n in ast.walk(body))
+    if assigned:
+        return False
+    return any(isinstance(n, ast.Name) and n.id == name
+               and isinstance(n.ctx, ast.Load)
+               for n in ast.walk(body))
+
+
+def check_resident_escape(project: Project) -> list[Finding]:
+    """RT014: a donated buffer that outlives its dispatch. RT004's
+    dataflow flags READS after the donating call; this rule flags the
+    two escapes that happen textually BEFORE it — a closure capturing
+    the donated name (late binding: the closure sees the donated buffer
+    no matter where it was defined) and a container/attribute store of
+    the name above the donating call (the stored reference — e.g. a
+    ResidentRegistry-tracked or cached buffer — dangles once XLA reuses
+    the pages)."""
+    from .concurrency import donating_factories_project
+    from .rules import _donating_factories, _donor_bindings
+
+    proj_factories = donating_factories_project(project)
+    out: list[Finding] = []
+    local_factories = {m.relpath: _donating_factories(m)
+                       for m in project.modules}
+    for fi in sorted(project.functions.values(),
+                     key=lambda f: (f.mod.relpath, f.node.lineno)):
+        mod = fi.mod
+
+        def resolve(call, _mod=mod):
+            callee = _resolve_cached(project, _mod, call)
+            if callee is None:
+                return None
+            return proj_factories.get((callee.mod.relpath,
+                                       callee.node.name))
+
+        donors = _donor_bindings(fi.node, local_factories[mod.relpath],
+                                 resolve=resolve)
+        if not donors:
+            continue
+        stores: dict[str, list[int]] = {}
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, (ast.Store, ast.Del)):
+                stores.setdefault(n.id, []).append(n.lineno)
+
+        for call, arg in _donor_calls(fi.node, donors):
+            # (1) closure capture — flag unless the name is rebound
+            # after the donate (then the closure's late-bound read sees
+            # the fresh value, the x = f(x) idiom)
+            rebound_after = any(s > call.lineno
+                                for s in stores.get(arg.id, []))
+            if not rebound_after:
+                for sub in ast.walk(fi.node):
+                    if not isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef,
+                                            ast.Lambda)):
+                        continue
+                    if sub is fi.node or \
+                            any(s is call for s in ast.walk(sub)):
+                        continue   # the donate happens inside the closure
+                    if _name_free_in(sub, arg.id):
+                        cname = getattr(sub, "name", "<lambda>")
+                        out.append(_finding(
+                            mod, "RT014", call,
+                            f"{arg.id!r} is donated to "
+                            f"{call.func.id!r} but also captured by "
+                            f"closure {cname!r} (line {sub.lineno}) — "
+                            f"the closure outlives the dispatch and "
+                            f"reads a buffer XLA has already reused; "
+                            f"capture a copy or rebind after dispatch",
+                            symbol=_qualname_of(mod, call)))
+                        break
+            # (2) container/attribute store above the donating call
+            for n in ast.walk(fi.node):
+                tgt = val = None
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                        isinstance(n.targets[0],
+                                   (ast.Subscript, ast.Attribute)):
+                    tgt, val = n.targets[0], n.value
+                elif isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in _STORE_METHODS:
+                    if any(isinstance(a, ast.Name) and a.id == arg.id
+                           for a in n.args):
+                        tgt, val = n.func.value, ast.Name(
+                            id=arg.id, ctx=ast.Load())
+                if tgt is None or not (isinstance(val, ast.Name)
+                                       and val.id == arg.id):
+                    continue
+                if not (n.lineno < call.lineno):
+                    continue   # post-donate loads are RT004's half
+                # a rebind between store and donate means the stored
+                # reference is an OLDER object, not the donated one
+                if any(n.lineno < s <= call.lineno
+                       for s in stores.get(arg.id, [])):
+                    continue
+                # the slot being overwritten after the dispatch clears
+                # the stale reference (self.state = fresh_result)
+                tdot = _dotted(tgt if isinstance(tgt, ast.Attribute)
+                               else getattr(tgt, "value", tgt))
+                overwritten = any(
+                    isinstance(m2, ast.Assign) and m2.lineno > call.lineno
+                    and any(_dotted(t2 if isinstance(t2, ast.Attribute)
+                                    else getattr(t2, "value", t2)) == tdot
+                            and tdot for t2 in m2.targets)
+                    for m2 in ast.walk(fi.node))
+                if overwritten:
+                    continue
+                out.append(_finding(
+                    mod, "RT014", call,
+                    f"{arg.id!r} is stored into {tdot or 'a container'!r}"
+                    f" (line {n.lineno}) and then donated to "
+                    f"{call.func.id!r} — the stored reference outlives "
+                    f"the dispatch and dangles once XLA reuses the "
+                    f"buffer; store a copy or the dispatch result "
+                    f"instead",
+                    symbol=_qualname_of(mod, call)))
+    return _dedupe(out)
+
+
+# ---------------------------------------------------------------------------
+# RT015 device-op-on-ingest-path
+
+
+#: relpath fragments that mark the ingest hot path: the pipeline sink,
+#: the watermark registry, the result sink, and the freshness tracker.
+#: Everything reachable from functions in these modules must stay
+#: numpy/stdlib — the ≤5% ingest-overhead budget (docs/INGESTION.md)
+#: assumes no device transfer, trace, or compile ever rides a batch.
+_INGEST_PATH_MODULES = ("ingestion/pipeline", "ingestion/watermark",
+                        "jobs/sink", "obs/freshness")
+
+#: jax entry points that are pure host-side bookkeeping — safe anywhere
+_INGEST_SAFE_JAX = {"jax.process_index", "jax.process_count",
+                    "jax.devices", "jax.local_devices",
+                    "jax.device_count", "jax.local_device_count"}
+
+
+def check_device_op_on_ingest_path(project: Project) -> list[Finding]:
+    """RT015: a jax/jnp call reachable from an ingest-chain function.
+    The first device op on the ingest path pays device transfer + maybe
+    a trace + maybe a compile — seconds, against a per-batch budget of
+    microseconds — and it does so on the writer thread, stalling the
+    watermark for every consumer."""
+    out: list[Finding] = []
+    reported: set = set()
+    roots = [fi for fi in project.functions.values()
+             if any(frag in fi.mod.relpath.replace("\\", "/")
+                    for frag in _INGEST_PATH_MODULES)]
+
+    for root in sorted(roots, key=lambda f: (f.mod.relpath,
+                                             f.node.lineno)):
+        def visit(fn: FuncInfo, node, locks, chain, _root=root):
+            if not isinstance(node, ast.Call):
+                return
+            d = _dotted(node.func)
+            base = d.split(".")[0]
+            if base not in ("jax", "jnp") or d in _INGEST_SAFE_JAX:
+                return
+            if id(node) in reported:
+                return
+            reported.add(id(node))
+            out.append(_finding(
+                fn.mod, "RT015", node,
+                f"device op {d}() is reachable from ingest-path "
+                f"{_root.label!r} (path: {_chain_str(chain)}) — the "
+                f"ingest hot path must stay numpy/stdlib (≤5% overhead "
+                f"budget); move device work behind the job/engine "
+                f"boundary",
+                symbol=_qualname_of(fn.mod, node)))
+
+        project.walk_from(root, visit, max_depth=4)
+    return _dedupe(out)

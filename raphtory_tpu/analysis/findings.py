@@ -19,6 +19,14 @@ from dataclasses import dataclass, field
 #: findings on the SAME line, or (as a standalone comment) on the next line.
 _PRAGMA = re.compile(r"#\s*rtpulint:\s*disable=([A-Za-z0-9_,\-\s]+)")
 
+#: SPMD-uniformity declaration: ``# rtpulint: spmd-uniform -- <why>``.
+#: Unlike ``disable=``, this is an ASSERTION with a mandatory justification
+#: — RT012 refuses to honour one whose justification is empty, so every
+#: silenced divergence site carries its reviewed uniformity argument in
+#: the source.
+_SPMD_UNIFORM = re.compile(
+    r"#\s*rtpulint:\s*spmd-uniform\b[\s:—–-]*(.*)$")
+
 
 @dataclass
 class Finding:
@@ -64,6 +72,22 @@ def parse_suppressions(lines: list[str]) -> dict[int, set[str]]:
         rules = {r.strip().lower() for r in m.group(1).split(",") if r.strip()}
         target = i + 1 if text.lstrip().startswith("#") else i
         out.setdefault(target, set()).update(rules)
+    return out
+
+
+def parse_spmd_uniform(lines: list[str]) -> dict[int, str]:
+    """1-based line → justification text for ``spmd-uniform`` pragmas
+    (``""`` when the author wrote none — the caller must treat that as
+    NOT suppressed). Same placement semantics as ``disable=``: a pragma
+    on a code line covers that line, a comment-only pragma line covers
+    the next line."""
+    out: dict[int, str] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _SPMD_UNIFORM.search(text)
+        if not m:
+            continue
+        target = i + 1 if text.lstrip().startswith("#") else i
+        out[target] = m.group(1).strip()
     return out
 
 

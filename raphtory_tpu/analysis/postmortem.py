@@ -24,7 +24,10 @@ Subcommands (``tools/rtpu-postmortem <cmd> --help``):
 * ``reconstruct DIR... --process N`` — a dead member's final story from
   its journal alone: last record, its final trace's sweep timeline,
   last live-epoch state per subscription, last query ledgers, the tail
-  of fault/breaker/degrade/sched events.
+  of fault/breaker/degrade/sched/mesh events — plus, when ≥2 processes
+  journaled ``mesh`` dispatch fingerprints, the SPMD-divergence
+  cross-check (the first superstep where fingerprints disagree, with
+  both processes' fingerprints side by side).
 * ``export DIR... --format chrome|collapsed`` — Chrome-trace JSON
   (span timestamps re-based onto each record's wall clock, so processes
   align on one axis) or collapsed stacks (self-time-weighted parent
@@ -185,8 +188,9 @@ def _summary_of(rec: dict) -> str:
         dur = d.get("dur")
         return (f"{name} ({dur / 1000.0:.3f} ms)"
                 if isinstance(dur, (int, float)) else name)
-    keys = ("decision", "algorithm", "mode", "site", "state", "reason",
-            "rule", "source", "job_id", "query_id", "metric")
+    keys = ("decision", "algorithm", "mode", "event", "seq", "site",
+            "route", "state", "reason", "rule", "source", "job_id",
+            "query_id", "metric")
     bits = [f"{k}={d[k]}" for k in keys if d.get(k) not in (None, "")]
     return " ".join(bits) if bits else json.dumps(d)[:80]
 
@@ -263,13 +267,40 @@ def reconstruct(records, process: int, tail: int = 10) -> dict:
              "job_id": (r.get("d") or {}).get("job_id"),
              "status": (r.get("d") or {}).get("status")}
             for r in ledgers[-tail:]]
-    for kind in ("fault", "breaker", "degrade", "sched", "fresh"):
+    for kind in ("fault", "breaker", "degrade", "sched", "fresh", "mesh"):
         rows = [r for r in mine if r.get("k") == kind]
         if rows:
             out[f"last_{kind}"] = [
                 {"wall": r.get("w"), "summary": _summary_of(r)}
                 for r in rows[-tail:]]
+    div = mesh_divergence(records)
+    if div is not None:
+        out["mesh_divergence"] = div
     return out
+
+
+def mesh_divergence(records) -> dict | None:
+    """The journal-replay SPMD-divergence cross-check: group every
+    ``mesh`` dispatch record by process and run the same fingerprint
+    prefix comparison ``/clusterz`` does live
+    (``analysis.sanitizer.mesh_prefix_divergence``) — after a hang was
+    SIGKILLed, the journals are all that is left to name the first
+    superstep where the processes' collective sequences disagreed.
+    Returns None when fewer than two processes journaled dispatches or
+    every common fingerprint agrees."""
+    from .sanitizer import mesh_prefix_divergence
+
+    rings: dict[int, list] = {}
+    for r in records:
+        if r.get("k") != "mesh":
+            continue
+        d = r.get("d") or {}
+        if d.get("event") != "dispatch" or "seq" not in d:
+            continue
+        rings.setdefault(int(r.get("p", 0)), []).append(d)
+    if len(rings) < 2:
+        return None
+    return mesh_prefix_divergence(rings)
 
 
 # ---------------------------------------------------------------- exports

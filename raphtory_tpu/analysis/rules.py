@@ -35,6 +35,10 @@ RULES = {
     "RT009": "blocking-call-under-lock",
     "RT010": "shared-state-without-common-lock",
     "RT011": "unbounded-growth-on-request-path",
+    "RT012": "collective-under-divergent-control-flow",
+    "RT013": "unstable-compile-key",
+    "RT014": "resident-buffer-escape",
+    "RT015": "device-op-on-ingest-path",
 }
 
 _ENV_VAR_RE = re.compile(r"^RTPU_[A-Z0-9_]+$")
@@ -664,9 +668,11 @@ _MODULE_CHECKS = {
 
 
 def _project_checks():
-    """Rule id → project-level pass. Imported lazily: concurrency.py
-    imports this module's helpers, so a top-level import would cycle."""
+    """Rule id → project-level pass. Imported lazily: concurrency.py and
+    devicecontract.py import this module's helpers, so a top-level
+    import would cycle."""
     from . import concurrency as cc
+    from . import devicecontract as dc
 
     return {
         "RT001": cc.check_env_in_cache_key_project,
@@ -675,6 +681,10 @@ def _project_checks():
         "RT009": cc.check_blocking_under_lock,
         "RT010": cc.check_shared_state_locksets,
         "RT011": cc.check_unbounded_growth,
+        "RT012": dc.check_collective_divergence,
+        "RT013": dc.check_unstable_compile_key,
+        "RT014": dc.check_resident_escape,
+        "RT015": dc.check_device_op_on_ingest_path,
     }
 
 

@@ -34,8 +34,24 @@ Findings go three ways: a ``logging`` warning, an in-process list
 the flight recorder timeline shows the hazard between the spans that
 caused it.
 
+``RTPU_SANITIZE=1`` also arms the **mesh-divergence sanitizer**
+(:class:`MeshSanitizer`) — the runtime half of the static RT012 rule.
+Every mesh dispatch appends a fingerprint ``(site, route, shape
+signature, dtype, superstep sequence number)`` to a bounded per-process
+ring and journals it as a ``mesh`` record (``obs/journal``), so two
+processes' dispatch prefixes can be cross-checked on ``/clusterz`` and
+in ``rtpu-postmortem reconstruct``: the FIRST sequence number whose
+fingerprints disagree names the exact collective where the SPMD
+programs diverged, with both processes' fingerprints side by side. A
+barrier-wait watchdog (``RTPU_SANITIZE_BARRIER_S``) turns the symptom
+of divergence — one process waiting forever in ``comm.barrier_wait``
+for a collective its peer never issued — into a finding plus a flight
+recorder instant WHILE the process is still hung.
+
 Zero overhead when disabled: nothing is imported or patched unless
 ``install()`` runs, and ``threading.Lock`` stays the pristine C factory.
+The mesh hooks (``note_mesh_dispatch``/``mesh_barrier_watch``) cost one
+module-global falsy check when the mesh sanitizer is not installed.
 """
 
 from __future__ import annotations
@@ -516,7 +532,255 @@ def track_shared(name: str) -> SharedTracker | None:
 
 
 def maybe_install_from_env() -> LockSanitizer | None:
-    """The ``raphtory_tpu/__init__`` hook: one env read when disabled."""
+    """The ``raphtory_tpu/__init__`` hook: one env read when disabled.
+    Arms BOTH sanitizers — the lock sanitizer and the mesh-divergence
+    sanitizer share the one ``RTPU_SANITIZE`` switch."""
     if os.environ.get("RTPU_SANITIZE", "0") in ("", "0", "false"):
         return None
+    mesh_install()
     return install()
+
+
+# =====================================================================
+# mesh-divergence sanitizer — the runtime half of rtpulint RT012
+# =====================================================================
+
+
+class MeshSanitizer:
+    """Per-process mesh-dispatch fingerprint ring + barrier watchdog.
+
+    The static RT012 rule catches collectives REACHABLE under
+    per-process control flow; this class catches the ones that actually
+    diverge in production. Each dispatch site calls
+    :meth:`note_dispatch` BEFORE issuing the collective, appending a
+    fingerprint record ``{seq, site, route, shape, dtype}`` to a
+    bounded ring (``deque(maxlen=...)`` — old supersteps fall off, a
+    long-running worker never grows) and journaling it as a ``mesh``
+    record when the journal is on. ``seq`` is a per-process dispatch
+    counter: in a correct SPMD program every process's sequence of
+    fingerprints is IDENTICAL, so the first ``seq`` where two
+    processes' fingerprints disagree is the first divergent superstep
+    (:func:`mesh_prefix_divergence` does that comparison for
+    ``/clusterz`` and the postmortem CLI).
+
+    :meth:`barrier_watch` arms a one-shot watchdog around a barrier
+    wait: if the collective has not returned after ``barrier_s``
+    seconds (``RTPU_SANITIZE_BARRIER_S``, 0/unset = off), a
+    ``mesh-barrier-stall`` finding and flight-recorder instant are
+    emitted FROM THE TIMER THREAD — the symptom of divergence is one
+    process blocked forever in a collective its peer never issued, so
+    the report cannot wait for the call to return. The timer factory is
+    injectable so tests drive the watchdog with a fake clock instead of
+    sleeping.
+    """
+
+    def __init__(self, capacity: int = 256, barrier_s: float | None = None,
+                 tracer=None, timer_factory=None):
+        import collections
+
+        # raw factory for the same reason as LockSanitizer: the mesh
+        # sanitizer only ever runs alongside the lock sanitizer, and a
+        # tracked internal mutex would show up in its own findings
+        self._mu = _RAW_LOCK()
+        self._ring = collections.deque(maxlen=max(1, int(capacity)))
+        self._seq = 0
+        self._findings: list[dict] = []
+        if barrier_s is None:
+            raw = os.environ.get("RTPU_SANITIZE_BARRIER_S", "") or "0"
+            try:
+                barrier_s = float(raw)
+            except ValueError:
+                barrier_s = 0.0
+        self.barrier_s = max(0.0, float(barrier_s))
+        self._tracer = tracer
+        self._timer_factory = timer_factory or threading.Timer
+        self._journal = None   # resolved lazily; False = unavailable
+
+    # ---- dispatch fingerprints ----
+
+    def note_dispatch(self, site: str, route: str, shape_sig: str,
+                      dtype: str) -> int:
+        """Record one mesh dispatch; returns its sequence number."""
+        with self._mu:
+            seq = self._seq
+            self._seq += 1
+            rec = {"seq": seq, "site": str(site), "route": str(route),
+                   "shape": str(shape_sig), "dtype": str(dtype)}
+            self._ring.append(rec)
+        # journaled OUTSIDE the collective: a dispatch that hangs (the
+        # exact failure this exists for) still leaves its record behind
+        self._journal_emit({"event": "dispatch", **rec})
+        return seq
+
+    def ring(self) -> list[dict]:
+        """Snapshot of the retained fingerprint records, oldest first."""
+        with self._mu:
+            return [dict(r) for r in self._ring]
+
+    def status_block(self) -> dict:
+        """The ``/statusz`` block: counters plus the ring itself (the
+        ring is what ``/clusterz`` cross-checks across processes)."""
+        with self._mu:
+            return {
+                "dispatches": self._seq,
+                "ring_capacity": self._ring.maxlen,
+                "barrier_watchdog_s": self.barrier_s,
+                "findings": len(self._findings),
+                "ring": [dict(r) for r in self._ring],
+            }
+
+    # ---- barrier watchdog ----
+
+    def barrier_watch(self, site: str, route: str):
+        """Arm a one-shot stall watchdog for the barrier wait the caller
+        is about to enter; returns a timer with ``.cancel()`` (call it
+        when the wait returns) or None when the watchdog is off."""
+        if self.barrier_s <= 0:
+            return None
+        san = self
+
+        def _fire():
+            finding = {
+                "kind": "mesh-barrier-stall",
+                "site": site,
+                "route": route,
+                "seconds": san.barrier_s,
+                "dispatches": san._seq,
+                "thread": threading.current_thread().name,
+            }
+            san._emit(
+                finding,
+                "barrier wait at %s (route %r) exceeded "
+                "RTPU_SANITIZE_BARRIER_S=%.3gs — probable SPMD divergence; "
+                "cross-check /clusterz mesh fingerprints for the first "
+                "divergent superstep", site, route, san.barrier_s)
+
+        t = self._timer_factory(self.barrier_s, _fire)
+        if hasattr(t, "daemon"):
+            t.daemon = True   # a hung barrier must not block interpreter exit
+        t.start()
+        return t
+
+    # ---- reporting (LockSanitizer._emit shape, minus stacks) ----
+
+    def _emit(self, finding: dict, msg: str, *fmt) -> None:
+        with self._mu:
+            self._findings.append(finding)
+        _log.warning("sanitizer: " + msg, *fmt)
+        tracer = self._tracer
+        if tracer is None:
+            try:
+                from ..obs.trace import TRACER as tracer
+            except Exception:
+                tracer = False
+            self._tracer = tracer
+        if tracer:
+            tracer.instant("sanitizer." + finding["kind"],
+                           **{k: v for k, v in finding.items()
+                              if k != "kind"})
+        self._journal_emit({"event": finding["kind"],
+                            **{k: v for k, v in finding.items()
+                               if k != "kind"}})
+
+    def _journal_emit(self, data: dict) -> None:
+        j = self._journal
+        if j is None:
+            try:
+                from ..obs import journal as j
+            except Exception:
+                j = False
+            self._journal = j
+        if j:
+            j.emit("mesh", data)
+
+    def findings(self, kind: str | None = None) -> list[dict]:
+        with self._mu:
+            out = list(self._findings)
+        if kind:
+            out = [f for f in out if f["kind"] == kind]
+        return out
+
+    def clear(self) -> None:
+        with self._mu:
+            self._findings.clear()
+            self._ring.clear()
+            self._seq = 0
+
+
+def mesh_prefix_divergence(rings: dict) -> dict | None:
+    """Cross-process fingerprint prefix check — the detector behind
+    ``/clusterz`` and ``rtpu-postmortem reconstruct``.
+
+    ``rings`` maps process id → list of fingerprint records (dicts with
+    ``seq``/``site``/``route``/``shape``/``dtype`` keys, exactly what
+    ``status_block()["ring"]`` or the journal's ``mesh`` dispatch
+    records carry). Every process is compared against the lowest
+    process id over the sequence numbers BOTH retain (rings are
+    bounded, so only the overlapping window is comparable). Returns
+    None when every common fingerprint agrees, else the FIRST divergent
+    step::
+
+        {"seq": ..., "process_a": ..., "fingerprint_a": ...,
+         "process_b": ..., "fingerprint_b": ...}
+
+    A process merely BEHIND its peers (fewer dispatches, all common
+    ones agreeing) is not divergence — it is an in-flight straggler, a
+    different signal, surfaced via the per-process dispatch counters.
+    """
+    def fp(rec: dict) -> str:
+        return "|".join(str(rec.get(k, ""))
+                        for k in ("site", "route", "shape", "dtype"))
+
+    procs = sorted(rings)
+    if len(procs) < 2:
+        return None
+    ref_p = procs[0]
+    ref = {int(r["seq"]): r for r in rings[ref_p] if "seq" in r}
+    for p in procs[1:]:
+        cur = {int(r["seq"]): r for r in rings[p] if "seq" in r}
+        for s in sorted(set(ref) & set(cur)):
+            a, b = fp(ref[s]), fp(cur[s])
+            if a != b:
+                return {"seq": s, "process_a": ref_p, "fingerprint_a": a,
+                        "process_b": p, "fingerprint_b": b}
+    return None
+
+
+#: the process-wide mesh sanitizer, set by mesh_install()
+_MESH: MeshSanitizer | None = None
+
+
+def mesh_install(**kwargs) -> MeshSanitizer:
+    """Install (or return) the process-wide mesh sanitizer."""
+    global _MESH
+    if _MESH is None:
+        _MESH = MeshSanitizer(**kwargs)
+    return _MESH
+
+
+def mesh_uninstall() -> None:
+    global _MESH
+    _MESH = None
+
+
+def mesh_active() -> MeshSanitizer | None:
+    return _MESH
+
+
+def note_mesh_dispatch(site: str, route: str, shape_sig: str,
+                       dtype: str) -> None:
+    """One-line dispatch hook for the parallel engines: a single
+    module-global falsy check when the mesh sanitizer is not installed
+    (the zero-overhead-when-unset contract, same as note_shared)."""
+    san = _MESH
+    if san is not None:
+        san.note_dispatch(site, route, shape_sig, dtype)
+
+
+def mesh_barrier_watch(site: str, route: str):
+    """Arm the barrier-stall watchdog, or None when disarmed — callers
+    hold the handle and ``.cancel()`` it when the wait returns."""
+    san = _MESH
+    if san is None:
+        return None
+    return san.barrier_watch(site, route)

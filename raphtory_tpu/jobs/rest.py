@@ -254,6 +254,11 @@ def _statusz(manager: AnalysisManager,
         # flush lag — what /clusterz federates so a mesh-wide postmortem
         # knows which members have replayable evidence
         "journal": _journal.status_block(),
+        # the mesh-divergence sanitizer (analysis/sanitizer.py, armed by
+        # RTPU_SANITIZE): per-process dispatch-fingerprint ring — what
+        # /clusterz prefix-checks across processes to name the first
+        # divergent superstep
+        "mesh_sanitizer": _mesh_sanitizer_block(),
         # the distributed half: which process this is, where its
         # listeners actually bound (what /clusterz discovery reads), and
         # what the cross-shard collectives moved
@@ -281,6 +286,18 @@ def _resilience_block() -> dict:
             if b["state"] != "closed"),
         "degraded_results": doc["degraded"].get("total", 0),
     }
+
+
+def _mesh_sanitizer_block() -> dict:
+    """The ``mesh_sanitizer`` block of /statusz: disabled stub when
+    RTPU_SANITIZE is off, else the fingerprint ring + counters the
+    /clusterz divergence cross-check consumes."""
+    from ..analysis.sanitizer import mesh_active
+
+    san = mesh_active()
+    if san is None:
+        return {"enabled": False}
+    return {"enabled": True, **san.status_block()}
 
 
 def _cluster_block(handler=None) -> dict:

@@ -324,6 +324,10 @@ def _peer_summary(status: dict) -> dict:
         # replayable evidence lives, how much of it, and whether the
         # writer is keeping up — the postmortem plane's discovery data
         "journal": status.get("journal"),
+        # the mesh-divergence sanitizer (analysis/sanitizer.py): this
+        # member's dispatch-fingerprint ring + counters — the raw
+        # material of the cluster-wide prefix cross-check
+        "mesh_sanitizer": status.get("mesh_sanitizer"),
     }
 
 
@@ -480,6 +484,45 @@ def _merge_journal(processes: dict) -> dict:
             "by_process": by_process}
 
 
+def _merge_mesh(processes: dict) -> dict:
+    """The SPMD-divergence cross-check: every sanitized peer's dispatch-
+    fingerprint ring compared pairwise against the lowest-indexed one
+    (``analysis.sanitizer.mesh_prefix_divergence``). In a correct run
+    every process issues the SAME sequence of mesh dispatches, so the
+    first sequence number whose fingerprints disagree names the exact
+    collective where the programs diverged — the root cause behind a
+    barrier-wait hang that the straggler signals can only see as "slow".
+    Dispatch counters ride along: a peer merely BEHIND (same prefix,
+    fewer dispatches) renders as skew, not divergence."""
+    rings: dict[str, list] = {}
+    dispatches: dict[str, int] = {}
+    findings_total = 0
+    enabled = 0
+    for name, p in processes.items():
+        ms = p.get("mesh_sanitizer") if p.get("reachable") else None
+        if not ms:
+            continue
+        if not ms.get("enabled"):
+            continue
+        enabled += 1
+        rings[name] = ms.get("ring") or []
+        dispatches[name] = int(ms.get("dispatches") or 0)
+        findings_total += int(ms.get("findings") or 0)
+    divergence = None
+    if len(rings) >= 2:
+        from ..analysis.sanitizer import mesh_prefix_divergence
+
+        divergence = mesh_prefix_divergence(rings)
+    counts = set(dispatches.values())
+    return {
+        "processes_enabled": enabled,
+        "dispatches_by_process": dispatches,
+        "dispatch_skew": (max(counts) - min(counts)) if counts else 0,
+        "findings_total": findings_total,
+        "divergence": divergence,
+    }
+
+
 def _merge_advisor(processes: dict) -> dict:
     """Every reachable peer's advisor block: total findings + the union
     of firing rule ids with per-process attribution."""
@@ -559,6 +602,7 @@ def clusterz(manager=None, handler=None, trace_id: str | None = None,
         "device": _merge_device(processes),
         "freshness": _merge_freshness(processes),
         "journal": _merge_journal(processes),
+        "mesh": _merge_mesh(processes),
         "stragglers": {
             name: p["collectives"]["barrier_wait_seconds"]
             for name, p in processes.items()

@@ -42,7 +42,7 @@ Record schema (JSON payload, compact keys — docs/OBSERVABILITY.md):
 key  meaning
 ===  ==========================================================
 k    kind: span|instant|series|ledger|advice|sched|epoch|fresh|
-     fault|breaker|degrade|meta
+     fault|breaker|degrade|mesh|meta
 w    wall-clock unix seconds at emit
 m    monotonic seconds (time.perf_counter) at emit
 p    process_index (cluster identity)

@@ -118,6 +118,15 @@ def run_columns_sharded(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
                         else 1 for a in repl_arrays))
     proc = TRACER.process_index
     multi = len({d.process_index for d in mesh.devices.flat}) > 1
+    # mesh-divergence sanitizer: fingerprint the dispatch before issuing
+    # it (same contract as parallel/sharded.py — a hang still journals)
+    from ..analysis.sanitizer import mesh_active
+
+    msan = mesh_active()
+    msite = f"parallel.columns.run_columns_sharded/{kind}"
+    if msan is not None:
+        msan.note_dispatch(msite, "replicate",
+                           f"D{n_dev}C{C}n{n_pad}", str(tdt))
     t0 = _time.perf_counter()
     with TRACER.span("comm.exchange", route="replicate",
                      direction="columns", process=proc,
@@ -129,6 +138,7 @@ def run_columns_sharded(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
             jnp.asarray(hop_of_col), jnp.asarray(T_col), jnp.asarray(w_col),
             *(put(a) for a in extra_host))
         barrier_wait = 0.0
+        # rtpulint: spmd-uniform — `multi` derives from the mesh's device set, which every process builds from the same global device list; all processes take the same arm
         if multi:
             # the columns span processes' devices — replicate back to
             # every host (reducers are host code), like
@@ -138,12 +148,18 @@ def run_columns_sharded(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
 
             jax.block_until_ready(result)
             t_bar = _time.perf_counter()
-            with TRACER.span("comm.barrier_wait", route="replicate",
-                             process=proc):
-                result = multihost_utils.process_allgather(result,
-                                                           tiled=True)
-                steps = multihost_utils.process_allgather(steps,
-                                                          tiled=True)
+            watch = (msan.barrier_watch(msite, "replicate")
+                     if msan is not None else None)
+            try:
+                with TRACER.span("comm.barrier_wait", route="replicate",
+                                 process=proc):
+                    result = multihost_utils.process_allgather(result,
+                                                               tiled=True)
+                    steps = multihost_utils.process_allgather(steps,
+                                                              tiled=True)
+            finally:
+                if watch is not None:
+                    watch.cancel()
             barrier_wait = _time.perf_counter() - t_bar
     COLLECTIVES.note_exchange(
         "replicate", "columns", rows=repl_rows * max(1, n_dev - 1),

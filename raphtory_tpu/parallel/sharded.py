@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..analysis.sanitizer import mesh_active
 from ..core.snapshot import GraphView, INT64_MIN
 from ..engine.bsp import _elem, _merge_aggs
 from ..engine.program import Context, Edges, VertexProgram
@@ -794,6 +795,17 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
         rows_dev = view.n_pad - sv.n_loc
     rows_step = rows_dev * k_loc * n_devices
     proc = TRACER.process_index
+    # mesh-divergence sanitizer: fingerprint this dispatch BEFORE issuing
+    # it, so a collective that hangs still leaves its record behind for
+    # the /clusterz prefix cross-check (site + route + compile shape +
+    # dtype + per-process dispatch seq must match on every process)
+    msan = mesh_active()
+    msite = f"parallel.sharded.run/{type(program).__name__}"
+    if msan is not None:
+        msan.note_dispatch(
+            msite, comm,
+            f"S{S}W{W}k{k_pad}n{view.n_pad}v{sv.n_loc}"
+            f"d{sv.m_loc_d}s{sv.m_loc_s}", "i64")
     with TRACER.span("comm.exchange", route=comm,
                      direction=program.direction, process=proc,
                      shards=S, windows=k_pad,
@@ -828,6 +840,7 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
             with TRACER.span("comm.block_wait", route=comm, process=proc):
                 jax.block_until_ready(result)
             block_wait = _time.perf_counter() - t_disp
+        # rtpulint: spmd-uniform — `multi` derives from the mesh's device set, which every process builds from the same global device list; all processes take the same arm
         if multi:
             # replicate the (cross-host sharded) result back to every
             # host — job reducers are host code and expect the full
@@ -837,10 +850,19 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
             from jax.experimental import multihost_utils
 
             t_bar = _time.perf_counter()
-            with TRACER.span("comm.barrier_wait", route=comm,
-                             process=proc):
-                result = multihost_utils.process_allgather(
-                    result, tiled=True)
+            # stall watchdog: divergence shows up as THIS wait never
+            # returning (a peer skipped the collective) — the watchdog
+            # reports from its timer thread while we are still hung
+            watch = (msan.barrier_watch(msite, comm)
+                     if msan is not None else None)
+            try:
+                with TRACER.span("comm.barrier_wait", route=comm,
+                                 process=proc):
+                    result = multihost_utils.process_allgather(
+                        result, tiled=True)
+            finally:
+                if watch is not None:
+                    watch.cancel()
             barrier_wait = _time.perf_counter() - t_bar
             block = True
         # superstep count is a device scalar on async dispatches — those

@@ -1215,7 +1215,8 @@ def test_cli_timings_and_budget(tmp_path, capsys):
                      "--timings"]) == 0
     report = json.loads(capsys.readouterr().out)
     assert set(report["timings_seconds"]) >= {
-        "RT001", "RT008", "RT009", "RT010", "RT011", "model"}
+        "RT001", "RT008", "RT009", "RT010", "RT011", "RT012", "RT013",
+        "RT014", "RT015", "model"}
     assert report["analysis_seconds"] >= 0
     # an absurd budget trips the exit even with zero findings
     assert cli_main([str(pkg), "--root", root,
@@ -1585,3 +1586,523 @@ def test_sanitizer_patches_device_get_and_block_until_ready():
     import jax
 
     assert not hasattr(jax.device_get, "__wrapped__")
+
+
+# ---------------------------------------------------------------------------
+# RT012 collective-under-divergent-control-flow
+
+
+RT012_POSITIVE = """
+    import jax
+
+    def sweep(x):
+        if jax.process_index() == 0:
+            return jax.lax.psum(x, "v")
+        return x
+"""
+
+
+def test_collective_under_process_index_flagged():
+    fs = lint(RT012_POSITIVE)
+    assert rules_of(fs) == ["collective-under-divergent-control-flow"]
+    assert "psum" in fs[0].message
+    assert "process_index" in fs[0].message
+
+
+def test_collective_under_timing_branch_flagged():
+    # the accidental variant: a branch on a measured duration — every
+    # process measures a different wall clock, so the arms diverge
+    fs = lint("""
+        import time
+        import jax
+
+        def sweep(x, budget):
+            t0 = time.perf_counter()
+            y = x + 1
+            slow = time.perf_counter() - t0 > budget
+            if slow:
+                return jax.lax.pmean(y, "v")
+            return y
+    """)
+    assert "collective-under-divergent-control-flow" in rules_of(fs)
+    assert "slow" in fs[0].message
+
+
+def test_transitive_dispatch_under_divergence_flagged():
+    # the call does not NAME a collective — it resolves to a function
+    # that dispatches one, and the fixpoint closure must see through it
+    fs = lint("""
+        import jax
+
+        def exchange(x):
+            return jax.lax.psum(x, "v")
+
+        def run(x):
+            if jax.process_index() == 0:
+                return exchange(x)
+            return x
+    """)
+    assert "collective-under-divergent-control-flow" in rules_of(fs)
+
+
+def test_collective_divergence_spmd_uniform_suppressed():
+    # a justified spmd-uniform pragma on the branch line is a reviewed
+    # uniformity assertion — honoured
+    fs = lint(RT012_POSITIVE.replace(
+        "if jax.process_index() == 0:",
+        "if jax.process_index() == 0:  "
+        "# rtpulint: spmd-uniform - single-host path, all procs agree"))
+    assert fs == []
+
+
+def test_collective_divergence_empty_pragma_still_flags():
+    # the pragma is an assertion, not a mute: with no justification the
+    # finding stays, and the message says what is missing
+    fs = lint(RT012_POSITIVE.replace(
+        "if jax.process_index() == 0:",
+        "if jax.process_index() == 0:  # rtpulint: spmd-uniform"))
+    assert rules_of(fs) == ["collective-under-divergent-control-flow"]
+    assert "EMPTY" in fs[0].message
+
+
+def test_collective_under_uniform_branch_clean():
+    # a branch on SPMD-uniform data (same value on every process) is the
+    # idiomatic guard and must not fire
+    fs = lint("""
+        import jax
+
+        def sweep(x, n_devices):
+            if n_devices > 1:
+                return jax.lax.psum(x, "v")
+            return x
+    """)
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# RT013 unstable-compile-key
+
+
+def test_traced_read_of_unkeyed_mutable_flagged():
+    # (a) wrong-program-reuse: the traced body bakes in a module-level
+    # mutable the lru_cache key does not carry
+    fs = lint("""
+        import functools
+        import jax
+
+        _SCALE = {"v": 2}
+
+        @functools.lru_cache(maxsize=4)
+        def compiled():
+            def run(x):
+                return x * _SCALE["v"]
+            return jax.jit(run)
+    """)
+    assert "unstable-compile-key" in rules_of(fs)
+    assert "_SCALE" in [f for f in fs
+                        if f.name == "unstable-compile-key"][0].message
+
+
+RT013_STORM = """
+    import functools
+    import time
+    import jax
+
+    @functools.lru_cache(maxsize=8)
+    def compiled(tol):
+        def run(x):
+            return x * tol
+        return jax.jit(run)
+
+    def dispatch(x):
+        dt = time.perf_counter()
+        fn = compiled(dt)
+        return fn(x)
+"""
+
+
+def test_timing_key_component_flagged():
+    # (b) compile storm: a measured timing is a fresh float every call,
+    # so the factory cache never hits and every dispatch recompiles
+    fs = lint(RT013_STORM)
+    assert "unstable-compile-key" in rules_of(fs)
+    assert "compile storm" in [f for f in fs
+                               if f.name == "unstable-compile-key"][0].message
+
+
+def test_lambda_key_component_flagged():
+    fs = lint("""
+        import functools
+        import jax
+
+        @functools.lru_cache(maxsize=8)
+        def compiled(fold):
+            return jax.jit(lambda x: fold(x))
+
+        def dispatch(x):
+            fn = compiled(lambda v: v + 1)
+            return fn(x)
+    """)
+    assert "unstable-compile-key" in rules_of(fs)
+    assert "identity-keyed" in [
+        f for f in fs if f.name == "unstable-compile-key"][0].message
+
+
+def test_unstable_compile_key_suppressed():
+    fs = lint(RT013_STORM.replace(
+        "fn = compiled(dt)",
+        "fn = compiled(dt)  # rtpulint: disable=unstable-compile-key"))
+    assert "unstable-compile-key" not in rules_of(fs)
+
+
+def test_stable_compile_key_clean():
+    # the repo idiom: keys are quantised host ints (n_pad, k_pad) — no
+    # finding on a stable hashable key
+    fs = lint("""
+        import functools
+        import jax
+
+        @functools.lru_cache(maxsize=8)
+        def compiled(n_pad):
+            def run(x):
+                return x * n_pad
+            return jax.jit(run)
+
+        def dispatch(x, n_pad):
+            fn = compiled(n_pad)
+            return fn(x)
+    """)
+    assert "unstable-compile-key" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------------
+# RT014 resident-buffer-escape
+
+
+RT014_CLOSURE = """
+    import jax
+
+    def step(state, delta):
+        apply = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+        def flush():
+            return state.sum()
+
+        out = apply(state, delta)
+        return out, flush
+"""
+
+
+def test_donated_closure_capture_flagged():
+    # the closure outlives the dispatch and late-binds to the donated
+    # buffer — RT004's read-after dataflow cannot see this half
+    fs = lint(RT014_CLOSURE)
+    assert "resident-buffer-escape" in rules_of(fs)
+    f = [f for f in fs if f.name == "resident-buffer-escape"][0]
+    assert "flush" in f.message and "state" in f.message
+
+
+def test_donated_container_store_flagged():
+    # the stored reference (a registry/cache slot) dangles once XLA
+    # reuses the donated pages
+    fs = lint("""
+        import jax
+
+        def step(cache, state, delta):
+            apply = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+            cache["last"] = state
+            out = apply(state, delta)
+            return out
+    """)
+    assert "resident-buffer-escape" in rules_of(fs)
+    assert "cache" in [f for f in fs
+                       if f.name == "resident-buffer-escape"][0].message
+
+
+def test_resident_escape_suppressed():
+    fs = lint(RT014_CLOSURE.replace(
+        "out = apply(state, delta)",
+        "out = apply(state, delta)  "
+        "# rtpulint: disable=resident-buffer-escape"))
+    assert "resident-buffer-escape" not in rules_of(fs)
+
+
+def test_rebound_after_dispatch_closure_clean():
+    # rebinding the name after the donate means the late-bound closure
+    # read sees the FRESH value — the documented fix
+    fs = lint("""
+        import jax
+
+        def step(state, delta):
+            apply = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+            def flush():
+                return state.sum()
+
+            out = apply(state, delta)
+            state = out
+            return state, flush
+    """)
+    assert "resident-buffer-escape" not in rules_of(fs)
+
+
+def test_overwritten_slot_clean():
+    # the slot is overwritten with the dispatch result after the donate
+    # — the stale reference is cleared, nothing dangles
+    fs = lint("""
+        import jax
+
+        def step(cache, state, delta):
+            apply = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+            cache["last"] = state
+            out = apply(state, delta)
+            cache["last"] = out
+            return out
+    """)
+    assert "resident-buffer-escape" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------------
+# RT015 device-op-on-ingest-path
+
+
+RT015_POSITIVE = """
+    import jax.numpy as jnp
+
+    def push_batch(batch):
+        return jnp.asarray(batch).sum()
+"""
+
+
+def test_device_op_in_ingest_module_flagged():
+    fs = lint(RT015_POSITIVE, name="ingestion/pipeline.py")
+    assert "device-op-on-ingest-path" in rules_of(fs)
+    assert "jnp.asarray" in [f for f in fs
+                             if f.name == "device-op-on-ingest-path"][0].message
+
+
+def test_device_op_reachable_from_ingest_root_flagged():
+    # the device op hides one call down — walk_from must surface it
+    fs = lint("""
+        import jax.numpy as jnp
+
+        def _to_device(batch):
+            return jnp.asarray(batch)
+
+        def push_batch(batch):
+            return _to_device(batch)
+    """, name="obs/freshness.py")
+    assert "device-op-on-ingest-path" in rules_of(fs)
+
+
+def test_device_op_on_ingest_path_suppressed():
+    fs = lint(RT015_POSITIVE.replace(
+        "return jnp.asarray(batch).sum()",
+        "return jnp.asarray(batch).sum()  "
+        "# rtpulint: disable=device-op-on-ingest-path"),
+        name="ingestion/pipeline.py")
+    assert "device-op-on-ingest-path" not in rules_of(fs)
+
+
+def test_host_side_jax_bookkeeping_on_ingest_clean():
+    # process_index/device_count are pure host bookkeeping — safe
+    fs = lint("""
+        import jax
+
+        def push_batch(batch):
+            shard = len(batch) % max(1, jax.process_count())
+            return shard
+    """, name="ingestion/watermark.py")
+    assert "device-op-on-ingest-path" not in rules_of(fs)
+
+
+def test_device_op_outside_ingest_modules_clean():
+    # the same source outside the ingest chain is the engine's job —
+    # not this rule's business
+    fs = lint(RT015_POSITIVE, name="core/sweep.py")
+    assert "device-op-on-ingest-path" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------------
+# mesh-divergence sanitizer (the runtime half of RT012)
+
+
+class _FakeTimer:
+    """Injected in place of threading.Timer: captures the callback so
+    tests drive the watchdog by hand instead of sleeping."""
+
+    def __init__(self, interval, fn):
+        self.interval, self.fn = interval, fn
+        self.started = self.cancelled = False
+        self.daemon = False
+
+    def start(self):
+        self.started = True
+
+    def cancel(self):
+        self.cancelled = True
+
+
+def test_mesh_ring_bounded_and_seq_monotonic():
+    san = san_mod.MeshSanitizer(capacity=4)
+    seqs = [san.note_dispatch("site", "halo", f"S{i}", "i64")
+            for i in range(6)]
+    assert seqs == [0, 1, 2, 3, 4, 5]
+    ring = san.ring()
+    assert len(ring) == 4                      # old supersteps fell off
+    assert [r["seq"] for r in ring] == [2, 3, 4, 5]
+    block = san.status_block()
+    assert block["dispatches"] == 6            # counter keeps the truth
+    assert block["ring_capacity"] == 4
+    assert block["findings"] == 0
+
+
+def test_mesh_prefix_divergence_detects_first_mismatch():
+    def rec(seq, shape):
+        return {"seq": seq, "site": "a", "route": "halo",
+                "shape": shape, "dtype": "i64"}
+
+    agree = {0: [rec(0, "x"), rec(1, "y")],
+             1: [rec(0, "x"), rec(1, "y")]}
+    assert san_mod.mesh_prefix_divergence(agree) is None
+
+    diverged = {0: [rec(0, "x"), rec(1, "y"), rec(2, "z")],
+                1: [rec(0, "x"), rec(1, "Y"), rec(2, "Z")]}
+    div = san_mod.mesh_prefix_divergence(diverged)
+    assert div["seq"] == 1                     # FIRST divergent step
+    assert div["process_a"] == 0 and div["process_b"] == 1
+    assert div["fingerprint_a"] != div["fingerprint_b"]
+    assert "y" in div["fingerprint_a"] and "Y" in div["fingerprint_b"]
+
+
+def test_mesh_behind_peer_is_not_divergence():
+    # a straggler (fewer dispatches, all common ones agreeing) is skew,
+    # not divergence — that signal rides the per-process counters
+    def rec(seq):
+        return {"seq": seq, "site": "a", "route": "halo",
+                "shape": "x", "dtype": "i64"}
+
+    rings = {0: [rec(0), rec(1), rec(2)], 1: [rec(0)]}
+    assert san_mod.mesh_prefix_divergence(rings) is None
+    assert san_mod.mesh_prefix_divergence({0: [rec(0)]}) is None
+
+
+def test_mesh_prefix_compares_only_common_window():
+    # rings are bounded: only the overlapping seq window is comparable,
+    # and a mismatch outside it must not (and cannot) be reported
+    def rec(seq, shape):
+        return {"seq": seq, "site": "a", "route": "halo",
+                "shape": shape, "dtype": "i64"}
+
+    rings = {0: [rec(s, "x") for s in range(0, 6)],
+             1: [rec(s, "x" if s != 4 else "DIVERGED")
+                 for s in range(3, 9)]}
+    div = san_mod.mesh_prefix_divergence(rings)
+    assert div is not None and div["seq"] == 4
+
+
+def test_mesh_barrier_watchdog_fires_and_cancels():
+    san = san_mod.MeshSanitizer(barrier_s=2.5, tracer=False,
+                                timer_factory=_FakeTimer)
+    t = san.barrier_watch("parallel.sharded.run/PageRank", "halo")
+    assert t.started and t.daemon              # armed, never blocks exit
+    t.fn()                                     # the barrier never returned
+    found = san.findings("mesh-barrier-stall")
+    assert len(found) == 1
+    assert found[0]["site"] == "parallel.sharded.run/PageRank"
+    assert found[0]["route"] == "halo"
+    assert found[0]["seconds"] == 2.5
+    assert san.status_block()["findings"] == 1
+    # the happy path: the wait returns and the caller cancels
+    t2 = san.barrier_watch("s", "replicate")
+    t2.cancel()
+    assert t2.cancelled
+    assert len(san.findings("mesh-barrier-stall")) == 1
+
+
+def test_mesh_barrier_watchdog_disarmed_by_default(monkeypatch):
+    monkeypatch.delenv("RTPU_SANITIZE_BARRIER_S", raising=False)
+    san = san_mod.MeshSanitizer(timer_factory=_FakeTimer)
+    assert san.barrier_s == 0.0
+    assert san.barrier_watch("s", "halo") is None   # nothing armed
+    monkeypatch.setenv("RTPU_SANITIZE_BARRIER_S", "1.5")
+    assert san_mod.MeshSanitizer().barrier_s == 1.5
+    monkeypatch.setenv("RTPU_SANITIZE_BARRIER_S", "nonsense")
+    assert san_mod.MeshSanitizer().barrier_s == 0.0
+
+
+def test_mesh_dispatch_and_stall_journaled():
+    class _FakeJournal:
+        def __init__(self):
+            self.records = []
+
+        def emit(self, kind, data, **kw):
+            self.records.append((kind, dict(data)))
+
+    j = _FakeJournal()
+    san = san_mod.MeshSanitizer(barrier_s=1.0, tracer=False,
+                                timer_factory=_FakeTimer)
+    san._journal = j
+    san.note_dispatch("site", "halo", "S4W2", "i64")
+    t = san.barrier_watch("site", "halo")
+    t.fn()
+    kinds = [(k, d["event"]) for k, d in j.records]
+    assert kinds == [("mesh", "dispatch"), ("mesh", "mesh-barrier-stall")]
+    disp = j.records[0][1]
+    assert disp["seq"] == 0 and disp["shape"] == "S4W2"
+
+
+def test_mesh_disarmed_is_free():
+    # RTPU_SANITIZE unset → mesh_active() is None and every hook is one
+    # module-global falsy check; /statusz reports the stub block
+    prev = san_mod._MESH
+    san_mod.mesh_uninstall()
+    try:
+        assert san_mod.mesh_active() is None
+        san_mod.note_mesh_dispatch("s", "halo", "x", "i64")   # no-op
+        assert san_mod.mesh_barrier_watch("s", "halo") is None
+        from raphtory_tpu.jobs.rest import _mesh_sanitizer_block
+        assert _mesh_sanitizer_block() == {"enabled": False}
+    finally:
+        san_mod._MESH = prev
+
+
+def test_mesh_install_lifecycle_and_statusz():
+    prev = san_mod._MESH
+    san_mod.mesh_uninstall()
+    try:
+        san = san_mod.mesh_install(capacity=8)
+        assert san_mod.mesh_install() is san   # idempotent
+        assert san_mod.mesh_active() is san
+        san_mod.note_mesh_dispatch("s", "halo", "x", "i64")
+        assert len(san.ring()) == 1
+        from raphtory_tpu.jobs.rest import _mesh_sanitizer_block
+        block = _mesh_sanitizer_block()
+        assert block["enabled"] is True and block["dispatches"] == 1
+        san.clear()
+        assert san.ring() == [] and san.status_block()["dispatches"] == 0
+    finally:
+        san_mod._MESH = prev
+
+
+def test_postmortem_mesh_divergence_from_journal_records():
+    from raphtory_tpu.analysis import postmortem
+
+    def mesh_rec(p, seq, shape):
+        return {"k": "mesh", "p": p,
+                "d": {"event": "dispatch", "seq": seq, "site": "a",
+                      "route": "halo", "shape": shape, "dtype": "i64"}}
+
+    records = [
+        mesh_rec(0, 0, "x"), mesh_rec(1, 0, "x"),
+        mesh_rec(0, 1, "x"), mesh_rec(1, 1, "DIVERGED"),
+        # non-dispatch mesh events and other kinds must be ignored
+        {"k": "mesh", "p": 0, "d": {"event": "mesh-barrier-stall"}},
+        {"k": "fault", "p": 0, "d": {"seq": 1}},
+    ]
+    div = postmortem.mesh_divergence(records)
+    assert div is not None and div["seq"] == 1
+    assert {div["process_a"], div["process_b"]} == {0, 1}
+    # a single process's records cannot diverge
+    assert postmortem.mesh_divergence(records[:1]) is None
+    assert postmortem.mesh_divergence([]) is None

@@ -25,7 +25,15 @@ proves the ISSUE-10 acceptance path end to end:
   process 1 (ISSUE-11: the advisor's distributed story);
 * the merged ``/clusterz`` freshness block (ISSUE-15) must carry both
   processes' safe times + watermark spread, and the delayed worker's
-  source must MOVE the merged min-watermark to its stalled fence.
+  source must MOVE the merged min-watermark to its stalled fence;
+* finally the mesh-divergence leg (ISSUE 19, ``RTPU_SMOKE_DIVERGE``,
+  on by default outside bench mode): both workers issue one more sweep
+  at the same dispatch seq with DIFFERENT window sets, and the merged
+  ``/clusterz`` mesh block must report the injected divergence naming
+  that exact superstep with both processes' fingerprints
+  (DIVERGENCE_OK) — without hanging, because each worker's mesh is
+  process-local and the fingerprint prefix check, not a stuck
+  collective, is the detector.
 
 The federated snapshot is written to ``--out`` (the CI failure
 artifact). Exit 0 prints CLUSTERZ_OK; any assertion prints the evidence
@@ -167,9 +175,36 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
         # rule reads, bar lowered to CI time via RTPU_ADVISOR_STALE_S)
         deadline = time.monotonic() + 600
         injected = False
+        diverged = False
         while not os.path.exists(sentinel):
             if time.monotonic() > deadline:
                 raise TimeoutError("no driver_done sentinel")
+            if not diverged and os.path.exists(
+                    os.path.join(tmpdir, "make_diverge")):
+                # mesh-divergence injection (ISSUE 19): issue a sweep
+                # shaped like nothing worker 0 runs — worker 0 issues its
+                # original body concurrently, so both processes advance
+                # one dispatch seq but with DIFFERENT (window-count →
+                # k_pad) compile-shape fingerprints. Local 2-device
+                # meshes mean no cross-process collective can hang; the
+                # fingerprint prefix check is the detector.
+                # the straggler phase pinned THIS process's safe time at
+                # 10 via the stalled source — a sweep at latest_time
+                # would wait on that fence forever instead of reaching
+                # the mesh. The straggler assertions are all done by the
+                # time worker 0 asks for divergence, so retire it.
+                if injected:
+                    graph.watermarks.finish("stalled-smoke")
+                dbody = {"analyserName": "PageRank",
+                         "timestamp": int(graph.latest_time),
+                         "windowType": "batched", "windowSet": [400],
+                         "params": {"max_steps": 10, "tol": 0.0}}
+                dsub = _http_json(f"{me}/ViewAnalysisRequest", dbody,
+                                  headers={"X-RTPU-Tenant": "smoke-w1"})
+                _wait_done(me, dsub["jobID"])
+                diverged = True
+                with open(os.path.join(tmpdir, "diverge_up"), "w") as f:
+                    f.write("ok")
             if not injected and os.path.exists(
                     os.path.join(tmpdir, "make_straggler")):
                 # a source that advanced ONCE then stalls: under the
@@ -390,6 +425,58 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
                       default=str)
     print("FRESHNESS_OK", flush=True)
 
+    # ---- mesh-divergence leg (ISSUE 19): on by default for the plain
+    # smoke, disabled by RTPU_SMOKE_DIVERGE=0 or bench mode (the driver
+    # keeps the sanitizer off while measuring overhead). Both workers
+    # issue one more sweep at the same dispatch seq but with different
+    # window sets — different compile shapes, so the /clusterz prefix
+    # cross-check must name that seq as the first divergent superstep.
+    if pairs == 0 and os.environ.get(
+            "RTPU_SMOKE_DIVERGE", "1") not in ("", "0", "false"):
+        mz = cz2.get("mesh") or {}
+        assert mz.get("processes_enabled") == 2, (
+            f"mesh sanitizer not armed on both workers: {mz}")
+        # the main phase ran the SAME body on both processes: prefixes
+        # must agree and dispatch counts must be level before injection
+        assert mz.get("divergence") is None, mz
+        counts = mz.get("dispatches_by_process") or {}
+        assert counts.get("process_0") == counts.get("process_1"), counts
+        seq_expected = counts["process_0"]
+        with open(os.path.join(tmpdir, "make_diverge"), "w") as f:
+            f.write("go")
+        div0 = _http_json(f"{me}/ViewAnalysisRequest", body,
+                          headers={"X-RTPU-Tenant": "smoke-w0"})
+        _wait_done(me, div0["jobID"])
+        deadline = time.monotonic() + 90
+        while not os.path.exists(os.path.join(tmpdir, "diverge_up")):
+            if time.monotonic() > deadline:
+                raise TimeoutError("worker 1 never injected divergence")
+            time.sleep(0.2)
+        div = cz3 = None
+        deadline = time.monotonic() + 30
+        while div is None and time.monotonic() < deadline:
+            cz3 = _http_json(f"{me}/clusterz?refresh=1")
+            div = (cz3.get("mesh") or {}).get("divergence")
+            if div is None:
+                time.sleep(0.5)
+        assert div is not None, (
+            f"injected divergence never detected: {cz3.get('mesh')}")
+        # the report must NAME the first divergent superstep and carry
+        # both processes' fingerprints side by side
+        assert div["seq"] == seq_expected, (div, seq_expected)
+        assert div["fingerprint_a"] and div["fingerprint_b"], div
+        assert div["fingerprint_a"] != div["fingerprint_b"], div
+        assert {div["process_a"], div["process_b"]} == {
+            "process_0", "process_1"}, div
+        if out:   # the artifact keeps the divergence verdict too
+            with open(out, "w") as f:
+                json.dump({"clusterz": cz, "trace": czt["trace"],
+                           "trace_id": tid, "advisez": az,
+                           "clusterz_post_straggler": cz2,
+                           "mesh_divergence": cz3.get("mesh")}, f,
+                          indent=1, default=str)
+        print("DIVERGENCE_OK", flush=True)
+
     with open(sentinel, "w") as f:
         f.write("ok")
     srv.stop()
@@ -437,6 +524,20 @@ def run_cluster(out: str | None = None, pairs: int = 0,
     # CI-sized staleness bar for the straggler phase: worker 1's stalled
     # fence must clear it in smoke time, not the 30 s production default
     env["RTPU_ADVISOR_STALE_S"] = "2"
+    # mesh-divergence leg (ISSUE 19): on by default for the plain smoke
+    # (RTPU_SMOKE_DIVERGE=0 disables); bench runs (pairs > 0) keep the
+    # sanitizer OFF so the overhead measurement stays uncontaminated.
+    # The workers' local meshes never span processes, so the injected
+    # divergence cannot hang a collective — the fingerprint prefix
+    # check is the detector, and the barrier watchdog rides along armed.
+    diverge = (pairs == 0 and os.environ.get(
+        "RTPU_SMOKE_DIVERGE", "1") not in ("", "0", "false"))
+    if diverge:
+        env["RTPU_SANITIZE"] = "1"
+        env.setdefault("RTPU_SANITIZE_BARRIER_S", "5")
+        env["RTPU_SMOKE_DIVERGE"] = "1"
+    else:
+        env["RTPU_SMOKE_DIVERGE"] = "0"
     procs = []
     for i in (0, 1):
         cmd = [sys.executable, os.path.abspath(__file__),
@@ -462,10 +563,20 @@ def run_cluster(out: str | None = None, pairs: int = 0,
         return {"skipped": True, "outputs": outs}
     for i, (p, o) in enumerate(zip(procs, outs)):
         if p.returncode != 0:
+            # both sides of the story: a worker-0 timeout is usually the
+            # SYMPTOM of the peer dying mid-handshake, so the peer's
+            # traceback is the one that matters
+            other = "\n".join(
+                f"--- worker {j} output ---\n{oo[-2000:]}"
+                for j, oo in enumerate(outs) if j != i)
             raise RuntimeError(
-                f"worker {i} failed (rc={p.returncode}):\n{o[-4000:]}")
+                f"worker {i} failed (rc={p.returncode}):\n{o[-4000:]}"
+                f"\n{other}")
     if "CLUSTERZ_OK" not in outs[0]:
         raise RuntimeError(f"worker 0 missing CLUSTERZ_OK:\n"
+                           f"{outs[0][-4000:]}")
+    if diverge and "DIVERGENCE_OK" not in outs[0]:
+        raise RuntimeError(f"worker 0 missing DIVERGENCE_OK:\n"
                            f"{outs[0][-4000:]}")
     res: dict = {"skipped": False, "outputs": outs}
     for line in outs[0].splitlines():
