@@ -29,6 +29,7 @@ class ConnectedComponents(VertexProgram):
     max_steps: int = 100
     combiner = "min"
     direction = "both"
+    monotone_min = True        # min-label merge — sparse-route eligible
     reduce_shell_safe = True   # reducer reads vids/v_mask only
     needs_vids = False
     needs_vertex_times = False

@@ -33,6 +33,7 @@ class SSSP(VertexProgram):
     top_k: int = 20                  # farthest reached vertices in the summary
     full_distances: bool = False     # opt-in: ship every reached distance
     combiner = "min"
+    monotone_min = True        # min-plus relaxation — sparse-route eligible
     reduce_shell_safe = True   # reducer reads vids/v_mask only
     needs_vertex_times = False
     needs_edge_times = False

@@ -138,6 +138,21 @@ class VertexProgram:
     # the full per-view path. (A non-overridden reduce is pass-through and
     # always safe.)
     reduce_shell_safe: bool = False
+    # Monotone min-merge declaration — the eligibility gate for the sparse
+    # frontier comm route (``parallel/frontier.py``). True asserts ALL of:
+    #   * ``combiner == "min"`` and state is a SINGLE array leaf;
+    #   * ``update(state, agg, ctx)`` is elementwise
+    #     ``where(v_mask, min(state, agg), pad)`` for a fixed pad constant
+    #     equal to the min-identity of the state dtype — so merging
+    #     per-owner partial updates elementwise-min reproduces the dense
+    #     result bitwise, and a no-message superstep is a fixed point;
+    #   * halt votes are exactly ``new == state`` (quiescence == no change);
+    #   * ``init``/``update``/``finalize`` never read ``ctx.out_deg`` /
+    #     ``ctx.in_deg`` (the sparse route computes degrees from the local
+    #     edge subset only — see docs/COMM.md "monotone-min contract").
+    # ConnectedComponents and SSSP/BFS satisfy this; PageRank-style dense
+    # fixpoints must keep the default False.
+    monotone_min: bool = False
 
     @property
     def cost_label(self) -> str:

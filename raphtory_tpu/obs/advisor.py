@@ -636,15 +636,41 @@ def rule_shard_skew(sig: dict) -> dict | None:
     if worst is None or worst[2] < 4.0:
         return None
     name, kind, val = worst
+    # route evidence: if the chooser is already taking the sparse route
+    # (or frontier densities say it should), say so — the remediation
+    # differs between "re-partition" and "let the sparse route absorb it"
+    route_counts: dict[str, int] = {}
+    density: dict[str, float] = {}
+    for n, p in rows.items():
+        coll = p.get("collectives") or {}
+        for key, cnt in ((coll.get("route_table") or {}).get("counts")
+                         or {}).items():
+            route_counts[key] = route_counts.get(key, 0) + int(cnt)
+        for key, d in (coll.get("frontier_density") or {}).items():
+            density[key] = max(density.get(key, 0.0), float(d))
+    sparse_taken = any(k.endswith("/sparse") for k in route_counts)
+    sparse_fits = any(d < 1.0 / 3.0 for d in density.values())
+    if sparse_taken:
+        fix = ("the sparse frontier route is already absorbing the skew "
+               "(docs/COMM.md) — if bytes stay high, re-balance with "
+               "RTPU_PARTITIONS")
+    elif sparse_fits:
+        fix = ("frontier density is under the sparse crossover — set "
+               "RTPU_COMM_ROUTE=auto (or =sparse) so min-merge sweeps "
+               "exchange compacted frontiers instead of dense state "
+               "(docs/COMM.md), or re-balance with RTPU_PARTITIONS")
+    else:
+        fix = ("re-balance: raise RTPU_PARTITIONS; dense frontiers keep "
+               "the sparse route out of crossover here (docs/COMM.md)")
     return _finding(
         "shard-skew",
         f"{name} reports {kind} partition skew {val:.1f}x (max/mean "
         "per-shard rows) — the hot shard serializes every superstep",
-        "RTPU_PARTITIONS",
-        "re-balance: raise RTPU_PARTITIONS, or route this graph's "
-        "exchanges via the sparse frontier path when it lands "
-        "(ROADMAP item 2)",
+        "RTPU_COMM_ROUTE",
+        fix,
         {"process": name, "kind": kind, "skew": round(val, 3),
+         "route_counts": route_counts,
+         "frontier_density": {k: round(v, 4) for k, v in density.items()},
          "skew_by_process": {n: (p.get("collectives") or {}).get("skew")
                              for n, p in rows.items()}})
 

@@ -304,6 +304,13 @@ def _peer_summary(status: dict) -> dict:
         "collectives": {
             "routes": routes,
             "skew": coll.get("skew"),
+            "skew_refreshes": coll.get("skew_refreshes"),
+            # the route chooser's verdict counts + measured frontier
+            # densities (PR 20) — the advisor's shard-skew rule reads
+            # these to tell "sparse route already absorbing it" from
+            # "operator should flip RTPU_COMM_ROUTE"
+            "route_table": coll.get("route_table"),
+            "frontier_density": coll.get("frontier_density"),
             "barrier_wait_seconds": round(sum(
                 r.get("barrier_wait_seconds", 0.0)
                 for r in routes.values()), 6),
@@ -484,6 +491,27 @@ def _merge_journal(processes: dict) -> dict:
             "by_process": by_process}
 
 
+def _merge_routes(processes: dict) -> dict:
+    """Cluster-wide per-route exchange totals + chooser verdict counts
+    summed over reachable peers — the at-a-glance answer to "what moved
+    over the wire, by route" (the smoke asserts sparse bytes HERE)."""
+    totals: dict[str, dict] = {}
+    decisions: dict[str, int] = {}
+    for p in processes.values():
+        coll = p.get("collectives") if p.get("reachable") else None
+        if not coll:
+            continue
+        for route, r in (coll.get("routes") or {}).items():
+            t = totals.setdefault(route, {"dispatches": 0, "supersteps": 0,
+                                          "rows": 0, "bytes": 0})
+            for k in t:
+                t[k] += int(r.get(k, 0))
+        for key, c in ((coll.get("route_table") or {}).get("counts")
+                       or {}).items():
+            decisions[key] = decisions.get(key, 0) + int(c)
+    return {"totals": totals, "decision_counts": decisions}
+
+
 def _merge_mesh(processes: dict) -> dict:
     """The SPMD-divergence cross-check: every sanitized peer's dispatch-
     fingerprint ring compared pairwise against the lowest-indexed one
@@ -603,6 +631,7 @@ def clusterz(manager=None, handler=None, trace_id: str | None = None,
         "freshness": _merge_freshness(processes),
         "journal": _merge_journal(processes),
         "mesh": _merge_mesh(processes),
+        "routes": _merge_routes(processes),
         "stragglers": {
             name: p["collectives"]["barrier_wait_seconds"]
             for name, p in processes.items()

@@ -206,6 +206,12 @@ class Metrics:
             "Host seconds between local program completion and the "
             "cross-process result allgather completing — the per-process "
             "straggler-wait signal", ["route"], registry=r)
+        self.route_decisions = Counter(
+            "raphtory_comm_route_decisions_total",
+            "Comm-route chooser verdicts per mesh dispatch "
+            "(parallel/sharded.py: halo | all_gather | sparse) — a route "
+            "flip under load shows as the sparse series taking over",
+            ["algorithm", "route"], registry=r)
         self.partition_skew = Gauge(
             "raphtory_partition_skew",
             "Max/mean per-shard row-count ratio of the latest partition "

@@ -53,6 +53,45 @@ AUTO_MIN_PAIRS = 1 << 17
 CACHE_BYTES = 2 << 20
 CACHELINE = 64
 
+#: default floor for sparse-frontier slice buckets (slots). Small enough
+#: that a near-quiescent superstep ships ~KBs; large enough that the
+#: power-of-two ladder above it has only ~log2(n/floor) rungs, so the
+#: collective shape set — and with it the process_allgather compile-key
+#: set — stays bounded (docs/COMM.md "bucketed padding").
+SPARSE_BUCKET_FLOOR = 256
+
+
+def sparse_bucket_floor() -> int:
+    """Resolved ``RTPU_SPARSE_BUCKETS`` (slot floor for frontier-slice
+    buckets). Read HERE, at dispatch time, by the sparse comm route —
+    never from inside a compiled-program cache factory (rtpulint RT001);
+    the resolved bucket length reaches collective shapes as an argument."""
+    import os
+
+    try:
+        v = int(os.environ.get("RTPU_SPARSE_BUCKETS", SPARSE_BUCKET_FLOOR))
+    except ValueError:
+        v = SPARSE_BUCKET_FLOOR
+    return max(8, v)
+
+
+def frontier_bucket(count: int, floor: int | None = None,
+                    cap: int | None = None) -> int:
+    """Bucketed capacity for a compacted frontier slice: the smallest
+    power of two >= ``count``, floored at ``floor`` slots (default: the
+    resolved ``RTPU_SPARSE_BUCKETS``) — the same shape-stabilising move
+    as ``_ALIGN``/``PartitionSpec.cap`` for the binned exchange, applied
+    to the DCN slice so every frontier size in a power-of-two band reuses
+    one collective shape. ``cap`` (when given) bounds the bucket from
+    above — the dense-slice size, past which padding buys nothing."""
+    floor = sparse_bucket_floor() if floor is None else max(1, int(floor))
+    b = floor
+    while b < count:
+        b <<= 1
+    if cap is not None:
+        b = min(b, max(int(cap), 1))
+    return b
+
 
 class PartitionSpec(NamedTuple):
     """Static shape descriptor of a built layout — the hashable component

@@ -127,6 +127,15 @@ def run_columns_sharded(tables, e_lat, e_alive, v_lat, v_alive, hop_times,
     if msan is not None:
         msan.note_dispatch(msite, "replicate",
                            f"D{n_dev}C{C}n{n_pad}", str(tdt))
+    # the column route has exactly one comm shape; record it in the same
+    # route table as the vertex-sharded dispatches so /statusz shows the
+    # full picture of what moved over the wire and why
+    COLLECTIVES.note_route_decision({
+        "algorithm": f"columns.{kind}", "route": "replicate",
+        "requested": "replicate",
+        "reason": "column-sharded dispatch replicates tables once",
+        "est_bytes": {"replicate": repl_bytes * max(1, n_dev - 1)},
+    })
     t0 = _time.perf_counter()
     with TRACER.span("comm.exchange", route="replicate",
                      direction="columns", process=proc,

@@ -36,8 +36,10 @@ managers + point-to-point actor messages + ack counting
 from __future__ import annotations
 
 import functools
+import os
 import threading
 import time as _time
+from collections import deque
 from dataclasses import dataclass
 
 import jax
@@ -84,6 +86,15 @@ class CollectiveStats:
         self._routes: dict[tuple, dict] = {}
         self._skew: dict | None = None
         self._skew_builds = 0
+        self._skew_refreshes = 0
+        # route-chooser evidence: measured frontier density per
+        # (algorithm, window-batch) key, and the decision log the
+        # /statusz route table renders. Densities come from ALLGATHERED
+        # per-process counts, so every process records identical history
+        # — the chooser staying SPMD-uniform depends on it (COMM.md)
+        self._frontier: dict[str, deque] = {}
+        self._route_log: deque = deque(maxlen=64)
+        self._route_counts: dict[tuple, int] = {}
 
     def note_partition(self, skew: dict) -> None:
         """Record the latest partition build's per-shard skew histogram
@@ -125,23 +136,76 @@ class CollectiveStats:
                 m.collective_barrier_wait.labels(route).inc(
                     float(barrier_wait))
 
+    def note_skew_refresh(self, skew: dict) -> None:
+        """A post-ingest sampled skew recompute (NOT a partition build):
+        replaces the published histogram so the route chooser and the
+        advisor's shard-skew rule never read day-1 skew after a large
+        ingest suffix shifted the load (docs/COMM.md)."""
+        with self._lock:
+            self._skew = skew
+            self._skew_refreshes += 1
+
+    def note_route_decision(self, decision: dict) -> None:
+        """One dispatch's route-chooser verdict + evidence — the
+        ``/statusz`` route table's feed (journaled by the dispatcher)."""
+        algo = str(decision.get("algorithm", "?"))
+        route = str(decision.get("route", "?"))
+        with self._lock:
+            self._route_log.append(dict(decision))
+            key = (algo, route)
+            self._route_counts[key] = self._route_counts.get(key, 0) + 1
+        m = _metrics()
+        if m is not None:
+            m.route_decisions.labels(algo, route).inc()
+
+    def note_frontier(self, key: str, density: float,
+                      supersteps: int) -> None:
+        """Measured mean frontier density of one sparse dispatch, keyed
+        by (algorithm, window-batch) — the chooser's crossover input."""
+        with self._lock:
+            dq = self._frontier.setdefault(key, deque(maxlen=32))
+            dq.append((float(density), int(supersteps)))
+
+    def frontier_hint(self, key: str) -> float | None:
+        """Mean measured frontier density for ``key`` (None = no
+        history; the chooser then uses its cold-start prior)."""
+        with self._lock:
+            dq = self._frontier.get(key)
+            if not dq:
+                return None
+            return sum(d for d, _ in dq) / len(dq)
+
     def snapshot(self) -> dict:
         with self._lock:
             routes = {f"{r}/{d}": dict(v)
                       for (r, d), v in sorted(self._routes.items())}
             skew = dict(self._skew) if self._skew else None
             builds = self._skew_builds
+            refreshes = self._skew_refreshes
+            density = {k: round(sum(d for d, _ in dq) / len(dq), 6)
+                       for k, dq in sorted(self._frontier.items()) if dq}
+            table = {
+                "counts": {f"{a}/{r}": n for (a, r), n
+                           in sorted(self._route_counts.items())},
+                "recent": [dict(d) for d in list(self._route_log)[-8:]],
+            }
         for v in routes.values():
             v["seconds"] = round(v["seconds"], 6)
             v["barrier_wait_seconds"] = round(
                 v["barrier_wait_seconds"], 6)
-        return {"routes": routes, "skew": skew, "skew_builds": builds}
+        return {"routes": routes, "skew": skew, "skew_builds": builds,
+                "skew_refreshes": refreshes,
+                "frontier_density": density, "route_table": table}
 
     def clear(self) -> None:
         with self._lock:
             self._routes.clear()
             self._skew = None
             self._skew_builds = 0
+            self._skew_refreshes = 0
+            self._frontier.clear()
+            self._route_log.clear()
+            self._route_counts.clear()
 
 
 #: process-wide collective accounting every mesh dispatch records into
@@ -182,6 +246,192 @@ def note_partition_skew(skew: dict) -> None:
         TRACER.instant("comm.partition",
                        process=TRACER.process_index,
                        **{f"{k}_skew": v["skew"] for k, v in skew.items()})
+
+
+def sampled_skew(sv, max_cols: int = 1 << 16) -> dict:
+    """Cheap post-ingest recompute of the per-shard edge skew from the
+    CURRENT block masks (the partition-time histogram goes stale the
+    moment a large ingest suffix shifts the load — the amortised sweep
+    path never rebuilds its partition). Blocks wider than ``max_cols``
+    are column-sampled at a deterministic stride and scaled back up; the
+    static halo slot histogram is carried over unchanged (halo capacity
+    does not move after the build)."""
+    def counts(mask):
+        m = mask.shape[1]
+        step = max(1, m // max_cols)
+        c = np.count_nonzero(mask[:, ::step], axis=1).astype(np.float64)
+        return c * step
+
+    kinds = {"edges_dst": counts(sv.d_mask), "edges_src": counts(sv.s_mask)}
+    skew = shard_skew(**kinds)
+    if sv.skew:
+        for kind in ("halo_dst", "halo_src"):
+            if kind in sv.skew:
+                skew[kind] = dict(sv.skew[kind])
+    return skew
+
+
+def refresh_partition_skew(sv) -> dict:
+    """Recompute + republish the skew of an EXISTING partition from live
+    masks (``sampled_skew``) and stamp it onto the sharded view, so every
+    downstream reader — the route chooser's evidence, the advisor's
+    shard-skew rule, the ``/statusz`` gauges — sees post-ingest load, not
+    the day-1 histogram. Counted separately from partition builds."""
+    skew = sampled_skew(sv)
+    sv.skew = skew
+    COLLECTIVES.note_skew_refresh(skew)
+    m = _metrics()
+    if m is not None:
+        for kind, s in skew.items():
+            m.partition_skew.labels(kind).set(s["skew"])
+    if TRACER.enabled:
+        TRACER.instant("comm.skew_refresh",
+                       process=TRACER.process_index,
+                       **{f"{k}_skew": v["skew"] for k, v in skew.items()})
+    return skew
+
+
+#: comm routes a dispatch can take (docs/COMM.md route catalogue)
+COMM_ROUTES = ("halo", "all_gather", "sparse")
+
+
+def _dense_auto(sv, view, program, S: int) -> str:
+    """The pre-sparse auto rule, unchanged: halo wins when the referenced
+    remote rows are fewer than the remote rows all_gather would replicate
+    (n_pad - n_loc per device); ties go to all_gather, whose single
+    collective schedules better."""
+    return ("halo" if S > 1
+            and sv.halo_rows(program.direction) < view.n_pad - sv.n_loc
+            else "all_gather")
+
+
+def choose_route(program, view, sv, mesh, requested: str, k: int,
+                 multi: bool, *, env: str | None = None,
+                 density_hint: float | None = None) -> dict:
+    """Measured-driven comm-route decision for one dispatch. Returns the
+    decision record (route + evidence) the dispatcher publishes as a
+    ``comm.route`` instant, a journal record and a /statusz route-table
+    row.
+
+    SPMD-uniformity (the RT012 pragma-free design): every decision input
+    is identical on every process by construction — shapes and halo/pad
+    sizes come from the replicated partition build, ``multi`` from the
+    mesh's global device list, skew from data-replicated ingestion, and
+    frontier-density history from ALLGATHERED per-process counts
+    (``CollectiveStats.note_frontier`` records the global density).
+    Per-process measurements (exchange seconds, barrier wait) are
+    carried as *evidence only* and never read by the decision.
+
+    ``env``/``density_hint`` override the environment knob and the
+    recorded history for decision-table tests."""
+    from . import frontier as _frontier
+
+    if env is None:
+        env = os.environ.get("RTPU_COMM_ROUTE", "auto").strip().lower()
+    env = env or "auto"
+    env_valid = env in COMM_ROUTES + ("auto",)
+    S = mesh.shape[V_AXIS]
+    label = program.cost_label
+    key = f"{label}/k{k}"
+    eligible = _frontier.supported(program)
+    if density_hint is None:
+        density_hint = COLLECTIVES.frontier_hint(key)
+    measured = density_hint is not None
+    density = _frontier.PRIOR_DENSITY if density_hint is None else density_hint
+
+    # per-superstep byte estimates (the crossover model, docs/COMM.md):
+    # dense routes replicate rows to every device each superstep; sparse
+    # ships one (index, value) slot per globally-changed row, floored at
+    # the bucket length each participating process pads to
+    item = 4          # eligible state leaves are i32 labels / f32 dists
+    slot = 8 + item   # i64 flat index + value
+    n_dev = int(mesh.devices.size)
+    n_procs = len({d.process_index for d in mesh.devices.flat})
+    est = {
+        "all_gather": (view.n_pad - sv.n_loc) * k * item * n_dev,
+        "halo": sv.halo_rows(program.direction) * k * item * n_dev,
+        "sparse": max(density * k * view.n_pad * slot,
+                      _partition_floor() * n_procs * slot),
+    }
+    dense_pick = _dense_auto(sv, view, program, S)
+
+    route = requested
+    reason = "explicit comm= argument"
+    if requested == "auto":
+        if env != "auto" and env_valid:
+            route = env
+            reason = "forced by RTPU_COMM_ROUTE"
+        elif not env_valid:
+            route = "auto"
+            reason = f"invalid RTPU_COMM_ROUTE={env!r} ignored"
+        else:
+            route = "auto"
+            reason = "auto"
+    if route == "sparse" and not eligible:
+        if requested == "sparse":
+            raise ValueError(
+                f"comm='sparse' requires the monotone_min contract; "
+                f"{type(program).__name__} does not declare it")
+        route = dense_pick
+        reason = ("RTPU_COMM_ROUTE=sparse ignored: "
+                  f"{label} is not monotone_min — dense fallback")
+    if route == "auto":
+        if eligible and multi and est["sparse"] < min(est["all_gather"],
+                                                     est["halo"]):
+            route = "sparse"
+            reason = ("measured density" if measured else "prior density") \
+                + " puts sparse below both dense routes"
+        else:
+            route = dense_pick
+            if not eligible:
+                reason = "program not monotone_min: dense volume rule"
+            elif not multi:
+                reason = "single-process mesh: dense volume rule"
+            else:
+                reason = "frontier density above crossover: dense volume rule"
+
+    skew_max = 0.0
+    if sv.skew:
+        skew_max = max(float(s.get("skew", 1.0)) for s in sv.skew.values())
+    # evidence-only route history (bytes are shape-derived and uniform;
+    # seconds/barrier_wait are per-process and deliberately NOT inputs)
+    hist = {}
+    snap = COLLECTIVES.snapshot()["routes"]
+    for rk, v in snap.items():
+        r = rk.split("/")[0]
+        h = hist.setdefault(r, {"bytes": 0, "supersteps": 0,
+                                "barrier_wait_seconds": 0.0})
+        h["bytes"] += v["bytes"]
+        h["supersteps"] += v["supersteps"]
+        h["barrier_wait_seconds"] = round(
+            h["barrier_wait_seconds"] + v["barrier_wait_seconds"], 6)
+    return {
+        "algorithm": label,
+        "key": key,
+        "requested": requested,
+        "env": env if env != "auto" else None,
+        "route": route,
+        "reason": reason,
+        "eligible": eligible,
+        "evidence": {
+            "n_pad": int(view.n_pad),
+            "k": int(k),
+            "shards": int(S),
+            "processes": int(n_procs),
+            "multi": bool(multi),
+            "density": round(float(density), 6),
+            "density_measured": measured,
+            "est_bytes_per_superstep": {r: int(b) for r, b in est.items()},
+            "skew_max": round(skew_max, 4),
+            "route_history": hist,
+        },
+    }
+
+
+def _partition_floor() -> int:
+    from ..ops.partition import sparse_bucket_floor
+
+    return sparse_bucket_floor()
 
 
 def _shard_map(fn, *, mesh, in_specs, out_specs):
@@ -688,14 +938,19 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
 
     ``comm`` picks the cross-shard state route: ``"all_gather"`` replicates
     the state along the vertex axis each superstep, ``"halo"`` exchanges only
-    the remote rows each shard's edges reference (one all_to_all), and
-    ``"auto"`` (default) picks halo whenever its measured exchange volume is
-    smaller.
+    the remote rows each shard's edges reference (one all_to_all),
+    ``"sparse"`` ships only the changed-since-last-superstep rows as
+    bucketed compact slices (monotone-min programs only —
+    ``parallel/frontier.py``), and ``"auto"`` (default) asks the
+    measured-driven chooser (``choose_route``; ``RTPU_COMM_ROUTE``
+    forces a route for auto dispatches). docs/COMM.md catalogues the
+    routes and the crossover model.
 
     ``block=False`` returns device arrays without waiting (steps stays a
     device scalar) so a range sweep can overlap the next hop's host fold
     with this hop's supersteps — the mesh twin of ``bsp.run_async``.
-    Multi-process runs always block (results must allgather to hosts)."""
+    Multi-process runs always block (results must allgather to hosts);
+    so does the sparse route (its superstep loop is host-driven)."""
     batched = windows is not None
     occurrences = bool(getattr(program, "needs_occurrences", False))
     if program.combiner == "custom" and program.direction == "both":
@@ -724,15 +979,82 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
         sv = partition_view(view, S, tuple(program.edge_props),
                             occurrences=occurrences)
 
-    if comm not in ("auto", "halo", "all_gather"):
-        raise ValueError(f"comm must be auto|halo|all_gather, got {comm!r}")
-    if comm == "auto":
-        # halo wins when the referenced remote rows are fewer than the
-        # remote rows all_gather would replicate (n_pad - n_loc per device);
-        # ties go to all_gather, whose single collective schedules better
-        comm = ("halo" if S > 1
-                and sv.halo_rows(program.direction) < view.n_pad - sv.n_loc
-                else "all_gather")
+    if comm not in ("auto",) + COMM_ROUTES:
+        raise ValueError(
+            f"comm must be auto|halo|all_gather|sparse, got {comm!r}")
+
+    # Multi-host gate: the MESH actually spanning processes, not
+    # jax.process_count() — a process of a multi-host cluster sweeping
+    # its own local devices must not attempt cross-process collectives.
+    multi = len({d.process_index for d in mesh.devices.flat}) > 1
+
+    # Route decision: explicit comm= wins; RTPU_COMM_ROUTE (read HERE,
+    # at dispatch — rtpulint RT001) steers "auto"; the measured-driven
+    # chooser otherwise picks by estimated bytes/superstep. The decision
+    # + evidence is published as a comm.route instant, a journal record,
+    # and a /statusz route-table row (docs/COMM.md).
+    decision = choose_route(program, view, sv, mesh, comm, k, multi)
+    comm = decision["route"]
+    proc = TRACER.process_index
+    COLLECTIVES.note_route_decision(decision)
+    if TRACER.enabled:
+        ev = decision["evidence"]
+        TRACER.instant(
+            "comm.route", process=proc, algorithm=decision["algorithm"],
+            route=comm, requested=decision["requested"],
+            reason=decision["reason"], density=ev["density"],
+            skew_max=ev["skew_max"],
+            **{f"est_{r}": b
+               for r, b in ev["est_bytes_per_superstep"].items()})
+    from ..obs import journal as _journal
+
+    if _journal.enabled():
+        _journal.emit("comm.route", decision)
+
+    # mesh-divergence sanitizer: fingerprint this dispatch BEFORE issuing
+    # it, so a collective that hangs still leaves its record behind for
+    # the /clusterz prefix cross-check. The fingerprint includes the
+    # ROUTE — processes disagreeing on the chooser's verdict at the same
+    # dispatch seq flag as divergence (tests/test_sparse_route.py)
+    msan = mesh_active()
+    msite = f"parallel.sharded.run/{type(program).__name__}"
+    msig = (f"S{S}W{W}k{k_pad}n{view.n_pad}v{sv.n_loc}"
+            f"d{sv.m_loc_d}s{sv.m_loc_s}")
+    if msan is not None:
+        msan.note_dispatch(msite, comm, msig, "i64")
+
+    if comm == "sparse":
+        from . import frontier as _frontier
+
+        with TRACER.span("comm.exchange", route="sparse",
+                         direction=program.direction, process=proc,
+                         shards=S, windows=k) as csp:
+            t0 = _time.perf_counter()
+            # rtpulint: spmd-uniform — `comm` is choose_route's verdict, whose every input is replicated by construction (shapes/halo sizes from the partition build, `multi` from the global device list, skew from data-replicated ingestion, density from ALLGATHERED counts; per-process seconds are evidence-only) — all processes pick the same route, and the runtime mesh sanitizer fingerprints the route per dispatch to catch any drift
+            result, steps, acct = _frontier.run_sparse(
+                program, view, mesh, sv, wlist, multi=multi,
+                msan=msan, msite=msite)
+            seconds = _time.perf_counter() - t0
+            csp.set(supersteps=acct["supersteps"], rows=acct["rows"],
+                    bytes=acct["bytes"],
+                    density=round(acct["density"], 6),
+                    fallback_supersteps=acct["fallback_supersteps"],
+                    barrier_wait_seconds=round(acct["barrier_wait"], 6))
+        COLLECTIVES.note_exchange(
+            "sparse", program.direction, rows=acct["rows"],
+            bytes_=acct["bytes"], seconds=seconds,
+            supersteps=acct["supersteps"],
+            barrier_wait=acct["barrier_wait"])
+        COLLECTIVES.note_frontier(decision["key"], acct["density"],
+                                  acct["supersteps"])
+        from ..obs import ledger as _ledger
+
+        led = _ledger.current()
+        if led is not None:
+            led.add_dcn("sparse", rows=acct["rows"], bytes_=acct["bytes"])
+        if not batched:
+            result = jax.tree_util.tree_map(lambda a: a[0], result)
+        return result, steps
 
     # window masks, computed from per-shard latest-time arrays
     v_masks = np.empty((k_pad, S, sv.n_loc), bool)
@@ -761,12 +1083,7 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
     # (data-replicated ingestion — the reference replays every update to
     # every PM's router the same way), so each input becomes a GLOBAL
     # jax.Array by slicing out this process's addressable shards. On one
-    # process this degrades to a plain device put. Gated on the MESH
-    # actually spanning processes, not on jax.process_count(): a process
-    # of a multi-host cluster sweeping its own local devices must not
-    # attempt a cross-process allgather of a locally-addressable result.
-    multi = len({d.process_index for d in mesh.devices.flat}) > 1
-
+    # process this degrades to a plain device put.
     def dev(x, spec):
         if not multi:
             return jnp.asarray(x)
@@ -794,18 +1111,6 @@ def run(program: VertexProgram, view: GraphView, mesh: Mesh, *,
     else:
         rows_dev = view.n_pad - sv.n_loc
     rows_step = rows_dev * k_loc * n_devices
-    proc = TRACER.process_index
-    # mesh-divergence sanitizer: fingerprint this dispatch BEFORE issuing
-    # it, so a collective that hangs still leaves its record behind for
-    # the /clusterz prefix cross-check (site + route + compile shape +
-    # dtype + per-process dispatch seq must match on every process)
-    msan = mesh_active()
-    msite = f"parallel.sharded.run/{type(program).__name__}"
-    if msan is not None:
-        msan.note_dispatch(
-            msite, comm,
-            f"S{S}W{W}k{k_pad}n{view.n_pad}v{sv.n_loc}"
-            f"d{sv.m_loc_d}s{sv.m_loc_s}", "i64")
     with TRACER.span("comm.exchange", route=comm,
                      direction=program.direction, process=proc,
                      shards=S, windows=k_pad,

@@ -117,6 +117,14 @@ class ShardedSweep:
                              v_first_time=self.sv.v_first.reshape(-1))
         self.sv.view = self._shell
         self.t_now: int | None = None
+        # Round-7 finding: ``sv.skew`` was computed ONCE above and never
+        # again, so after a large ingest suffix the route chooser and the
+        # advisor's shard-skew rule kept reading day-1 balance. Track edge
+        # rows touched since the last skew publication and recompute
+        # (sampled, O(S * min(m_loc, 64Ki))) once a quarter of the edge
+        # table has churned.
+        self._rows_since_skew = 0
+        self._skew_refresh_rows = max(256, t.m // 4)
 
     # ---- sweep driving ----
 
@@ -149,6 +157,10 @@ class ShardedSweep:
                 blocks[0][sh, sl] = d["e_alive"]
                 blocks[1][sh, sl] = d["e_lat"]
                 blocks[2][sh, sl] = d["e_first"]
+            self._rows_since_skew += len(pos)
+            if self._rows_since_skew >= self._skew_refresh_rows:
+                self._rows_since_skew = 0
+                sharded.refresh_partition_skew(sv)
 
     # ---- dispatch ----
 

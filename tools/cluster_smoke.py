@@ -1,22 +1,28 @@
 #!/usr/bin/env python3
-"""2-process localhost cluster smoke + observability-overhead bench.
+"""N-process localhost cluster smoke + observability-overhead bench.
 
-Driver (default mode) spawns TWO worker processes that form a real
+Driver (default mode) spawns ``RTPU_SMOKE_N`` worker processes
+(default 2; CI also runs the 4-process leg) that form a real
 `jax.distributed` cluster on localhost (CPU backend, 2 local devices
-each), each serving REST on a port-strided listener. The smoke then
-proves the ISSUE-10 acceptance path end to end:
+each), each serving REST on a port-strided listener (ISSUE-10 port
+striding — worker i listens on rest_base + i). The smoke then proves
+the ISSUE-10 acceptance path end to end:
 
+* every worker runs one ConnectedComponents sweep over the SPARSE
+  frontier route (ISSUE 20) before serving, so each process's
+  ``/statusz`` — and the merged ``/clusterz`` route roll-up — must
+  show nonzero sparse-route collective bytes;
 * worker 0 submits a sharded sweep to ITSELF, forwards the SAME request
-  to worker 1 with the ``X-RTPU-Trace`` header — one REST-initiated
-  sweep, ONE trace id across both processes;
+  to every peer with the ``X-RTPU-Trace`` header — one REST-initiated
+  sweep, ONE trace id across all N processes;
 * ``/tracez?trace_id=`` on the origin process shows the local half;
-* ``/clusterz`` on worker 0 must show BOTH members reachable, watchdog
+* ``/clusterz`` on worker 0 must show ALL N members reachable, watchdog
   membership, per-process watermark lag, nonzero per-route collective
   bytes, per-shard halo skew, and barrier-wait fields;
 * ``/clusterz?trace_id=`` must reassemble the trace with spans from
-  BOTH processes;
+  EVERY process;
 * each worker's job carries its own ``X-RTPU-Tenant`` identity and the
-  merged ``/clusterz`` workload view must show BOTH tenant accounts
+  merged ``/clusterz`` workload view must show every tenant account
   with per-process attribution (ISSUE-11);
 * finally worker 1 is DELAYED (a live source advances once then stops
   feeding, stalling its watermark fence — ACTIVE-stalled, not idle,
@@ -105,7 +111,7 @@ def _wait_done(base, job_id, timeout_s=300.0):
     raise TimeoutError(f"job {job_id} not done in {timeout_s}s")
 
 
-def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
+def worker(idx: int, n: int, coord_port: int, rest_base: int, tmpdir: str,
            pairs: int, cheap: bool, out: str | None) -> None:
     import jax
 
@@ -131,7 +137,7 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
     from raphtory_tpu.parallel import sharded
 
     assert bootstrap(coordinator_address=f"127.0.0.1:{coord_port}",
-                     num_processes=2, process_id=idx)
+                     num_processes=n, process_id=idx)
     assert TRACER.process_index == idx
 
     # identical synthetic stream on both processes (the reference's
@@ -160,26 +166,43 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
     mgr = AnalysisManager(graph, mesh=mesh)
     srv = RestServer(mgr, port=rest_base, watchdog=wd).start()
     me = f"http://127.0.0.1:{srv.port}"
-    peer = f"http://127.0.0.1:{rest_base + (1 - idx)}"
+    peers = [f"http://127.0.0.1:{rest_base + j}"
+             for j in range(n) if j != idx]
     print(f"worker {idx} rest on {srv.port}", flush=True)
 
+    # ---- sparse frontier route leg (ISSUE 20): every worker — at the
+    # SAME dispatch seq, so the mesh sanitizer prefixes stay level —
+    # runs one min-merge sweep over comm="sparse". The compacted-slice
+    # accounting publishes nonzero sparse-route bytes on each process's
+    # /statusz even on a process-local mesh, which the driver-side
+    # merged /clusterz route roll-up must then show.
+    from raphtory_tpu import build_view
+    from raphtory_tpu.algorithms.connected_components import (
+        ConnectedComponents)
+
+    sharded.run(ConnectedComponents(max_steps=10),
+                build_view(pipe.log, int(graph.latest_time)), mesh,
+                comm="sparse")
+
     _wait_http(f"{me}/healthz")
-    _wait_http(f"{peer}/healthz")
+    for peer in peers:
+        _wait_http(f"{peer}/healthz")
     sentinel = os.path.join(tmpdir, "driver_done")
 
-    if idx == 1:
-        # serve until worker 0 finishes its assertions; when asked,
-        # become the DELAYED member — a live source that never feeds
-        # holds this process's watermark fence still, so its lag grows
-        # while the peer's stays 0 (what the advisor's cluster-straggler
-        # rule reads, bar lowered to CI time via RTPU_ADVISOR_STALE_S)
+    if idx != 0:
+        # serve until worker 0 finishes its assertions; worker 1 (only)
+        # additionally becomes the DELAYED member when asked — a live
+        # source that never feeds holds this process's watermark fence
+        # still, so its lag grows while every peer's stays 0 (what the
+        # advisor's cluster-straggler rule reads, bar lowered to CI
+        # time via RTPU_ADVISOR_STALE_S); workers 2+ just serve.
         deadline = time.monotonic() + 600
         injected = False
         diverged = False
         while not os.path.exists(sentinel):
             if time.monotonic() > deadline:
                 raise TimeoutError("no driver_done sentinel")
-            if not diverged and os.path.exists(
+            if idx == 1 and not diverged and os.path.exists(
                     os.path.join(tmpdir, "make_diverge")):
                 # mesh-divergence injection (ISSUE 19): issue a sweep
                 # shaped like nothing worker 0 runs — worker 0 issues its
@@ -205,7 +228,7 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
                 diverged = True
                 with open(os.path.join(tmpdir, "diverge_up"), "w") as f:
                     f.write("ok")
-            if not injected and os.path.exists(
+            if idx == 1 and not injected and os.path.exists(
                     os.path.join(tmpdir, "make_straggler")):
                 # a source that advanced ONCE then stalls: under the
                 # idle/active watermark semantics (ISSUE-15) a
@@ -223,7 +246,7 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
                     f.write("ok")
             time.sleep(0.25)
         srv.stop()
-        print("worker 1 ok", flush=True)
+        print(f"worker {idx} ok", flush=True)
         return
 
     # ---- worker 0: the REST-initiated cross-process sweep ----
@@ -236,18 +259,22 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
     tid = sub0.get("traceID")
     assert tid, f"no traceID in submit response: {sub0}"
     assert sub0.get("tenant") == "smoke-w0", sub0
-    # forward the hop: the SAME trace id crosses the process boundary,
-    # under the PEER's tenant identity (the merged workload view must
-    # attribute each account to its own process)
+    # forward the hop to EVERY peer: the SAME trace id crosses each
+    # process boundary, under that peer's own tenant identity (the
+    # merged workload view must attribute each account to its process)
     wire = TraceContext(tid, 0, origin=idx).to_wire()
-    sub1 = _http_json(f"{peer}/ViewAnalysisRequest", body,
-                      headers={TraceContext.HEADER: wire,
-                               "X-RTPU-Tenant": "smoke-w1"})
-    assert sub1.get("traceID") == tid, (
-        f"peer opened its own trace: {sub1} != {tid}")
-    assert sub1.get("tenant") == "smoke-w1", sub1
+    peer_subs = []
+    for j, peer in zip(range(1, n), peers):
+        subj = _http_json(f"{peer}/ViewAnalysisRequest", body,
+                          headers={TraceContext.HEADER: wire,
+                                   "X-RTPU-Tenant": f"smoke-w{j}"})
+        assert subj.get("traceID") == tid, (
+            f"peer {j} opened its own trace: {subj} != {tid}")
+        assert subj.get("tenant") == f"smoke-w{j}", subj
+        peer_subs.append((peer, subj))
     _wait_done(me, sub0["jobID"])
-    _wait_done(peer, sub1["jobID"])
+    for peer, subj in peer_subs:
+        _wait_done(peer, subj["jobID"])
 
     # ---- collect the evidence FIRST (the CI failure artifact must
     # show what the cluster looked like even when an assertion fires)
@@ -264,31 +291,42 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
     assert any(s["name"] == "comm.exchange" for s in tz["spans"]), \
         "no comm.exchange span in the origin trace"
     procs = cz["processes"]
-    assert cz["processes_reachable"] == 2, procs
-    assert {p.get("process_index") for p in procs.values()} == {0, 1}, procs
+    assert cz["processes_reachable"] == n, procs
+    assert {p.get("process_index") for p in procs.values()} == \
+        set(range(n)), procs
     shard_members = cz["members"].get("shard", {})
-    assert shard_members.get("count") == 2, cz["members"]
+    assert shard_members.get("count") == n, cz["members"]
     for name, p in procs.items():
         routes = p["collectives"]["routes"]
         assert routes and any(r["bytes"] > 0 for r in routes.values()), \
             f"{name}: no collective bytes: {routes}"
+        assert any(k.startswith("sparse/") and r["bytes"] > 0
+                   for k, r in routes.items()), \
+            f"{name}: no sparse-route bytes: {routes}"
         skew = p["collectives"]["skew"]
         assert skew and "halo_dst" in skew and "edges_dst" in skew, \
             f"{name}: no halo/degree skew: {skew}"
         assert "barrier_wait_seconds" in p["collectives"], name
         assert p.get("watermark_lag_seconds") is not None, name
         assert "queue_depth" in p, name
+    # the merged route roll-up (ISSUE 20): sparse-route bytes summed
+    # over the cluster, plus the chooser's verdict counts
+    rt = (cz.get("routes") or {}).get("totals") or {}
+    assert any(k.startswith("sparse/") and r["bytes"] > 0
+               for k, r in rt.items()), f"no merged sparse bytes: {rt}"
+    decisions = (cz.get("routes") or {}).get("decision_counts") or {}
+    assert any(k.endswith("/sparse") for k in decisions), decisions
 
     with_spans = czt["trace"]["processes_with_spans"]
-    assert set(with_spans) >= {"process_0", "process_1"}, (
-        f"trace {tid} not reassembled from both processes: {with_spans}")
+    assert set(with_spans) >= {f"process_{j}" for j in range(n)}, (
+        f"trace {tid} not reassembled from all processes: {with_spans}")
 
     # ---- freshness plane in the MERGED view (ISSUE-15): both
     # processes' ingest telemetry federates — per-process safe times,
     # watermark spread, and a merged min-watermark (moved by the
     # straggler phase below)
     fz = cz["freshness"]
-    assert {"process_0", "process_1"} <= set(
+    assert {f"process_{j}" for j in range(n)} <= set(
         fz["watermark_lag_by_process"]), fz
     assert "watermark_spread_seconds" in fz, fz
     # both replays finished: every fence sits at the all-done sentinel,
@@ -308,7 +346,7 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
     deadline = time.monotonic() + 30
     while True:
         tenants = (cz.get("workload") or {}).get("tenants") or {}
-        if {"smoke-w0", "smoke-w1"} <= set(tenants):
+        if {f"smoke-w{j}" for j in range(n)} <= set(tenants):
             break
         if time.monotonic() > deadline:
             raise AssertionError(f"tenant accounts never federated: "
@@ -434,13 +472,13 @@ def worker(idx: int, coord_port: int, rest_base: int, tmpdir: str,
     if pairs == 0 and os.environ.get(
             "RTPU_SMOKE_DIVERGE", "1") not in ("", "0", "false"):
         mz = cz2.get("mesh") or {}
-        assert mz.get("processes_enabled") == 2, (
-            f"mesh sanitizer not armed on both workers: {mz}")
-        # the main phase ran the SAME body on both processes: prefixes
+        assert mz.get("processes_enabled") == n, (
+            f"mesh sanitizer not armed on all workers: {mz}")
+        # the main phase ran the SAME body on every process: prefixes
         # must agree and dispatch counts must be level before injection
         assert mz.get("divergence") is None, mz
         counts = mz.get("dispatches_by_process") or {}
-        assert counts.get("process_0") == counts.get("process_1"), counts
+        assert len(set(counts.values())) == 1, counts
         seq_expected = counts["process_0"]
         with open(os.path.join(tmpdir, "make_diverge"), "w") as f:
             f.write("go")
@@ -491,25 +529,35 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _free_port_pair() -> int:
-    """A base port with base+1 also free (the strided REST pair)."""
+def _free_port_run(n: int) -> int:
+    """A base port with base+1..base+n-1 also free (the strided REST
+    listeners — worker i binds rest_base + i)."""
     for _ in range(64):
         base = _free_port()
         try:
-            with socket.socket() as s:
-                s.bind(("127.0.0.1", base + 1))
+            for j in range(1, n):
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", base + j))
             return base
         except OSError:
             continue
-    raise RuntimeError("no free adjacent port pair")
+    raise RuntimeError(f"no free run of {n} adjacent ports")
 
 
 def run_cluster(out: str | None = None, pairs: int = 0,
-                cheap: bool = False, timeout_s: float = 600.0) -> dict:
-    """Spawn the 2-worker cluster; returns {skipped, outputs, pairs...}.
-    Raises on real failures (assertions inside a worker, timeouts)."""
+                cheap: bool = False, timeout_s: float = 600.0,
+                n: int | None = None) -> dict:
+    """Spawn the N-worker cluster (``n`` or RTPU_SMOKE_N, default 2);
+    returns {skipped, outputs, pairs...}. Raises on real failures
+    (assertions inside a worker, timeouts)."""
+    if n is None:
+        try:
+            n = int(os.environ.get("RTPU_SMOKE_N", "2"))
+        except ValueError:
+            n = 2
+    n = max(2, n)
     coord = _free_port()
-    rest_base = _free_port_pair()
+    rest_base = _free_port_run(n)
     tmpdir = tempfile.mkdtemp(prefix="rtpu_cluster_smoke_")
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -517,8 +565,8 @@ def run_cluster(out: str | None = None, pairs: int = 0,
     env.pop("XLA_FLAGS", None)
     env["RTPU_TRACE"] = "1"
     # forced, not setdefault: the worker's peer-URL math is rest_base +
-    # (1 - idx), i.e. stride 1 — an inherited RTPU_PORT_STRIDE=2 would
-    # bind worker 1 two ports up and the smoke would poll a dead port
+    # j, i.e. stride 1 — an inherited RTPU_PORT_STRIDE=2 would bind
+    # worker j two-j ports up and the smoke would poll dead ports
     env["RTPU_PORT_STRIDE"] = "1"
     env.pop("RTPU_CLUSTER_PEERS", None)   # derive from the topology
     # CI-sized staleness bar for the straggler phase: worker 1's stalled
@@ -539,9 +587,10 @@ def run_cluster(out: str | None = None, pairs: int = 0,
     else:
         env["RTPU_SMOKE_DIVERGE"] = "0"
     procs = []
-    for i in (0, 1):
+    for i in range(n):
         cmd = [sys.executable, os.path.abspath(__file__),
-               "--worker", str(i), "--coord-port", str(coord),
+               "--worker", str(i), "--n", str(n),
+               "--coord-port", str(coord),
                "--rest-base", str(rest_base), "--tmpdir", tmpdir,
                "--pairs", str(pairs)]
         if cheap:
@@ -551,7 +600,7 @@ def run_cluster(out: str | None = None, pairs: int = 0,
         procs.append(subprocess.Popen(
             cmd, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True))
-    outs = ["", ""]
+    outs = [""] * n
     try:
         for i, p in enumerate(procs):
             outs[i], _ = p.communicate(timeout=timeout_s)
@@ -588,6 +637,8 @@ def run_cluster(out: str | None = None, pairs: int = 0,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--worker", type=int, default=None)
+    ap.add_argument("--n", type=int, default=0,
+                    help="cluster size (driver: RTPU_SMOKE_N, default 2)")
     ap.add_argument("--coord-port", type=int, default=0)
     ap.add_argument("--rest-base", type=int, default=0)
     ap.add_argument("--tmpdir", default="")
@@ -598,10 +649,12 @@ def main(argv=None) -> int:
                     help="write the federated snapshot JSON here")
     args = ap.parse_args(argv)
     if args.worker is not None:
-        worker(args.worker, args.coord_port, args.rest_base, args.tmpdir,
-               args.pairs, args.cheap, args.out)
+        worker(args.worker, max(2, args.n), args.coord_port,
+               args.rest_base, args.tmpdir, args.pairs, args.cheap,
+               args.out)
         return 0
-    res = run_cluster(out=args.out, pairs=args.pairs, cheap=args.cheap)
+    res = run_cluster(out=args.out, pairs=args.pairs, cheap=args.cheap,
+                      n=args.n or None)
     if res["skipped"]:
         print("SKIPPED: this jax cannot form a localhost "
               "jax.distributed cluster")
